@@ -1,0 +1,54 @@
+"""partiallyshuffledistributedsampler_tpu — TPU-native partial-shuffle
+distributed sampling.
+
+A from-scratch JAX/XLA/Pallas framework with the capabilities of
+``microsoft/PartiallyShuffleDistributedSampler`` (see SURVEY.md): per-epoch
+*windowed* (partial) shuffle of a dataset's index space, deterministically
+partitioned across data-parallel ranks, with index generation running
+**on-device** — each rank's shuffled index tensor is emitted directly in HBM
+by a stateless keyed permutation, and the epoch seed is agreed over ICI by a
+collective instead of a host-side convention.
+
+Public surface
+--------------
+* ``epoch_indices_np`` / ``epoch_indices_jax`` — the pure functional core.
+* ``PartiallyShuffleDistributedSampler`` — drop-in ``torch.utils.data.Sampler``
+  (``__iter__``/``__len__``/``set_epoch`` kept intact; ``backend='xla'``
+  selects the on-device path).  Importing this attribute requires torch.
+* ``parallel`` — mesh-sharded regen with ICI seed agreement.
+* ``enable_big_index_space()`` — opt into >=2^31-sample index spaces (x64).
+
+The normative permutation law lives in ``SPEC.md`` at the repo root.
+"""
+
+__version__ = "0.1.0"
+
+from .ops import (  # noqa: F401
+    DEFAULT_ROUNDS,
+    DEFAULT_WINDOW,
+    epoch_indices_jax,
+    epoch_indices_np,
+    shard_sizes,
+)
+
+
+def enable_big_index_space() -> None:
+    """Enable uint64 position math (index spaces >= 2^31, e.g. the 10B-sample
+    Llama-pretrain config in BASELINE.json).  Must run before the first jit
+    of a big-n config."""
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+
+
+def __getattr__(name):
+    # Lazy subpackage access (torch / jax only imported when actually used).
+    if name in ("sampler", "parallel", "models", "utils"):
+        import importlib
+
+        return importlib.import_module(f".{name}", __name__)
+    if name == "PartiallyShuffleDistributedSampler":
+        from .sampler.torch_shim import PartiallyShuffleDistributedSampler
+
+        return PartiallyShuffleDistributedSampler
+    raise AttributeError(name)

@@ -1,0 +1,20 @@
+"""Permutation primitives: the spec core and its CPU/XLA/Pallas backends."""
+
+from .core import (  # noqa: F401
+    DEFAULT_ROUNDS,
+    DEFAULT_WINDOW,
+    derive_epoch_key,
+    epoch_indices_generic,
+    mix32,
+    shard_sizes,
+    swap_or_not,
+    windowed_perm,
+)
+from .cpu import epoch_indices_np, full_epoch_stream_np  # noqa: F401
+
+
+def epoch_indices_jax(*args, **kwargs):
+    """Lazy re-export so importing the package never forces jax init."""
+    from .xla import epoch_indices_jax as _impl
+
+    return _impl(*args, **kwargs)

@@ -1,0 +1,346 @@
+"""Backend-generic permutation core — the single source of truth for the spec.
+
+Every function here is written against an ``xp`` module argument (``numpy`` or
+``jax.numpy``) using ONLY exact uint32 wrap-around integer arithmetic, so the
+CPU (numpy) and XLA (jax) backends are bit-identical **by construction**.
+This realises the north-star requirement in ``BASELINE.json`` ("bit-identical
+to the CPU path") without chasing ``torch.randperm``'s sequential Fisher–Yates
+internals, which cannot be reproduced scalably on an accelerator (see
+SURVEY.md §7 "the one decision that shapes everything").
+
+Reference parity notes (SURVEY.md evidence tags):
+  * The *shape* of the contract (per-epoch permutation -> pad/drop ->
+    rank-slice) mirrors ``torch/utils/data/distributed.py:107-141`` [T].
+  * The *windowed* (partial) shuffle law is the reference's defining feature
+    per BASELINE.json north_star [B]; the precise law is OURS and is frozen in
+    ``SPEC.md`` at the repo root.
+
+The permutation law (see SPEC.md for the normative statement)
+-------------------------------------------------------------
+Let ``n`` be the dataset size and ``W`` the window size.  Split ``[0, n)``
+into ``nw_full = n // W`` full windows of size ``W`` plus a trailing partial
+window of ``tail = n - nw_full*W`` elements.  The epoch permutation
+``pi : [0, n) -> [0, n)`` maps an output *position* ``p`` to a dataset
+*index*:
+
+  * body (``p < nw_full*W``): output slot ``j = p // W`` draws its contents
+    from source window ``k = sigma(j)`` (``sigma`` = keyed bijection on
+    ``[0, nw_full)``; identity when ``order_windows=False``), and within the
+    window the offset is permuted by a per-window keyed bijection
+    ``rho_k`` on ``[0, W)``:  ``pi(p) = k*W + rho_k(p % W)``.
+  * tail (``p >= nw_full*W``): the partial window stays last and is permuted
+    within itself: ``pi(p) = nw_full*W + rho_tail(p - nw_full*W)``.
+
+All keyed bijections are the swap-or-not shuffle (Hoang–Morris–Rogaway,
+CRYPTO'12) which acts on an arbitrary domain ``[0, m)`` with no
+cycle-walking: it is stateless, O(rounds) per element, and embarrassingly
+parallel — exactly the shape the TPU VPU wants.
+
+Epoch stream and rank partition
+-------------------------------
+``stream(p) = pi(p mod n)`` for ``p in [0, total_size)`` — i.e. wrap-around
+padding with the head of the permuted stream, matching the base-class padding
+law (``distributed.py:116-127`` [T]).  Rank ``r`` of ``world`` receives
+positions ``r, r+world, r+2*world, ...`` (``partition='strided'``, the torch
+law, ``distributed.py:134`` [T]) or the contiguous block
+``[r*num_samples, (r+1)*num_samples)`` (``partition='blocked'``, better read
+locality on sharded storage).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+# ---------------------------------------------------------------------------
+# Spec constants.  Frozen: changing any of these changes every permutation.
+# ---------------------------------------------------------------------------
+DEFAULT_ROUNDS = 24
+DEFAULT_WINDOW = 4096
+
+_GOLDEN = 0x9E3779B9  # 2^32 / phi — round-constant stride for round keys
+_RC_BIT = 0x7FEB352D  # round-constant stride for the swap decision bit
+_C_SEED_HI = 0x85EBCA6B
+_C_EPOCH = 0xC2B2AE35
+_C_OUTER = 0xA5A5A5A5
+_C_INNER = 0x5A5A5A5A
+_C_TAIL = 0x3C3C3C3C
+_C_WIN = 0x27D4EB2F
+_C_BIT = 0x94D049BB
+_C_PAIR = 0x165667B1
+
+_M32 = 0xFFFFFFFF
+
+
+def _u32(xp: Any, v: int):
+    """A 0-d uint32 constant with silent wrap-around semantics.
+
+    numpy *scalars* raise RuntimeWarning on overflow; 0-d *arrays* wrap
+    silently, and jnp scalars always wrap.  Always build constants through
+    here.
+    """
+    return xp.asarray(v & _M32, dtype=xp.uint32)
+
+
+def mix32(xp: Any, x):
+    """murmur3 fmix32 finalizer — the spec's only hash primitive.
+
+    Bijective on uint32, ~1.5 ns/elem vectorised; identical in numpy and XLA
+    because it is pure uint32 xor/shift/multiply.
+    """
+    x = x ^ (x >> _u32(xp, 16))
+    x = x * _u32(xp, 0x85EBCA6B)
+    x = x ^ (x >> _u32(xp, 13))
+    x = x * _u32(xp, 0xC2B2AE35)
+    x = x ^ (x >> _u32(xp, 16))
+    return x
+
+
+# ---------------------------------------------------------------------------
+# Key schedule
+# ---------------------------------------------------------------------------
+
+def derive_epoch_key(xp: Any, seed, epoch):
+    """Fold ``(seed, epoch)`` into the epoch master key (uint32).
+
+    ``seed`` may be a python int of any size (hi/lo folded) or a traced
+    uint32 pair; ``epoch`` likewise.  Deterministic, communication-free:
+    all ranks that agree on (seed, epoch) agree on every index — the torch
+    convention (``distributed.py:40-42`` [T]); the sharded path additionally
+    *enforces* agreement over ICI (parallel/sharded.py).
+    """
+    import numpy as _np  # concrete-int normalization; never traces
+
+    if isinstance(seed, (int, _np.integer)):
+        seed = int(seed)
+        seed_lo = _u32(xp, seed & _M32)
+        seed_hi = _u32(xp, (seed >> 32) & _M32)
+    elif isinstance(seed, tuple):  # (lo, hi) pair, each int or traced uint32
+        seed_lo = xp.asarray(seed[0]).astype(xp.uint32)
+        seed_hi = xp.asarray(seed[1]).astype(xp.uint32)
+    else:  # traced/array scalar seed: uint32 lo, hi=0
+        seed_lo = xp.asarray(seed).astype(xp.uint32)
+        seed_hi = _u32(xp, 0)
+    if isinstance(epoch, (int, _np.integer)):
+        ep = _u32(xp, int(epoch) & _M32)
+    else:
+        ep = xp.asarray(epoch).astype(xp.uint32)
+    k = mix32(xp, seed_lo ^ _u32(xp, _GOLDEN))
+    k = mix32(xp, k ^ mix32(xp, seed_hi ^ _u32(xp, _C_SEED_HI)))
+    k = mix32(xp, k ^ mix32(xp, ep ^ _u32(xp, _C_EPOCH)))
+    return k
+
+
+def outer_key(xp: Any, epoch_key):
+    return mix32(xp, epoch_key ^ _u32(xp, _C_OUTER))
+
+
+def tail_key(xp: Any, epoch_key):
+    return mix32(xp, epoch_key ^ _u32(xp, _C_TAIL))
+
+
+def inner_key(xp: Any, epoch_key, window_id_u32):
+    """Per-source-window key for the intra-window bijection (vectorised)."""
+    return mix32(
+        xp,
+        epoch_key ^ _u32(xp, _C_INNER) ^ mix32(xp, window_id_u32 ^ _u32(xp, _C_WIN)),
+    )
+
+
+def inner_pair_key(xp: Any, epoch_key):
+    """Scalar pairing key shared by all windows' inner bijections."""
+    return mix32(xp, epoch_key ^ _u32(xp, _C_PAIR))
+
+
+# ---------------------------------------------------------------------------
+# Swap-or-not keyed bijection on [0, m)
+# ---------------------------------------------------------------------------
+
+def swap_or_not(xp: Any, x, m: int, key, rounds: int, pair_key=None):
+    """Keyed bijection on ``[0, m)`` for arbitrary ``m`` (1 <= m < 2^31).
+
+    ``x``: uint32 array of values in ``[0, m)`` (out-of-domain lanes produce
+    garbage that callers must mask — never out-of-range memory access).
+    ``key``: uint32 scalar or array broadcastable against ``x`` (the
+    per-window inner keys are vectors) — drives the swap *decision* bits.
+    ``pair_key``: uint32 SCALAR driving the round pairing constants ``K_r``;
+    defaults to ``key`` (which must then be scalar).
+
+    Per round ``r``: partner ``x' = (K_r - x) mod m`` with
+    ``K_r = mix32(pair_key ^ r*GOLDEN) mod m``; the pair ``{x, x'}`` is
+    canonical under ``max``, and a keyed bit of the canonical member decides
+    whether the pair swaps.  The pairing is an involution, so each round is a
+    bijection; the composition over ``rounds`` rounds is the permutation.
+
+    TPU shape of this: ``K_r`` is a *scalar* per round (one mod, hoisted out
+    of the element vector), so the per-element work is add/compare/select
+    plus ONE mix32 for the decision bit — pure VPU-friendly uint32 lanes, no
+    per-element division, no cycle-walking, no data-dependent trip counts.
+    Sharing the pairing schedule across windows while the decision bits stay
+    per-window keeps each window's map an independent-looking bijection (the
+    decision hash mixes the window key) at half the hash cost.
+    """
+    if m <= 1:
+        return x
+    if pair_key is None:
+        pair_key = key
+    m_u = _u32(xp, m)
+    key2 = mix32(xp, key ^ _u32(xp, _C_BIT))
+    for r in range(rounds):
+        k_r = mix32(xp, pair_key ^ _u32(xp, (r * _GOLDEN) & _M32)) % m_u
+        partner = k_r + (m_u - x)
+        partner = xp.where(partner >= m_u, partner - m_u, partner)
+        c = xp.maximum(x, partner)
+        b = mix32(xp, c ^ key2 ^ _u32(xp, (r * _RC_BIT) & _M32))
+        x = xp.where((b & _u32(xp, 1)) == _u32(xp, 1), partner, x)
+    return x
+
+
+# ---------------------------------------------------------------------------
+# Windowed permutation pi over [0, n)
+# ---------------------------------------------------------------------------
+
+def windowed_perm(
+    xp: Any,
+    p,
+    n: int,
+    window: int,
+    epoch_key,
+    *,
+    order_windows: bool = True,
+    rounds: int = DEFAULT_ROUNDS,
+    pos_dtype=None,
+):
+    """Map output positions ``p`` (values in [0, n)) to dataset indices.
+
+    ``p`` must already be wrapped mod n.  ``pos_dtype`` is the dtype used for
+    position arithmetic (uint32 suffices for n < 2^31; uint64 for the 10B
+    index space — requires x64 under jax).  Returned array has ``pos_dtype``.
+
+    Static args: n, window, order_windows, rounds — everything shape- or
+    branch-relevant is a python int so the jax path traces once per config.
+    """
+    if pos_dtype is None:
+        pos_dtype = xp.uint32 if n <= 0x7FFFFFFF else xp.uint64
+    p = xp.asarray(p).astype(pos_dtype)
+    W = int(window)
+    if W <= 0:
+        raise ValueError(f"window must be >= 1, got {W}")
+    if W > 0x7FFFFFFF:
+        raise ValueError("window must be < 2^31")
+    nw_full = n // W
+    if nw_full > 0x7FFFFFFF:
+        raise ValueError("n // window must be < 2^31")
+    body_len = nw_full * W
+    tail_len = n - body_len
+
+    W_p = xp.asarray(W, dtype=pos_dtype)
+    # --- body lanes -------------------------------------------------------
+    if nw_full > 0:
+        j = (p // W_p).astype(xp.uint32)
+        # clip tail lanes into domain; masked out at the end
+        j = xp.minimum(j, _u32(xp, nw_full - 1))
+        r0 = (p % W_p).astype(xp.uint32)
+        if order_windows and nw_full > 1:
+            k = swap_or_not(xp, j, nw_full, outer_key(xp, epoch_key), rounds)
+        else:
+            k = j
+        kin = inner_key(xp, epoch_key, k)
+        rho = swap_or_not(xp, r0, W, kin, rounds, pair_key=inner_pair_key(xp, epoch_key))
+        body_idx = k.astype(pos_dtype) * W_p + rho.astype(pos_dtype)
+    else:
+        body_idx = p  # no full windows; every lane is tail
+    # --- tail lanes -------------------------------------------------------
+    if tail_len > 0:
+        body_len_p = xp.asarray(body_len, dtype=pos_dtype)
+        tpos = xp.where(p >= body_len_p, p - body_len_p, xp.asarray(0, dtype=pos_dtype))
+        tpos32 = xp.minimum(tpos.astype(xp.uint32), _u32(xp, tail_len - 1))
+        rho_t = swap_or_not(xp, tpos32, tail_len, tail_key(xp, epoch_key), rounds)
+        tail_idx = body_len_p + rho_t.astype(pos_dtype)
+        if nw_full > 0:
+            idx = xp.where(p < body_len_p, body_idx, tail_idx)
+        else:
+            idx = tail_idx
+    else:
+        idx = body_idx
+    return idx
+
+
+# ---------------------------------------------------------------------------
+# Length / padding math  (contract of torch distributed.py:92-105 [T])
+# ---------------------------------------------------------------------------
+
+def shard_sizes(n: int, world: int, drop_last: bool) -> tuple[int, int]:
+    """Return ``(num_samples, total_size)``.
+
+    Mirrors the base-class law: ``drop_last`` floors to a world-divisible
+    total (dropping the tail); otherwise ceil + wrap-padding.
+    """
+    if n <= 0:
+        raise ValueError(f"dataset size must be >= 1, got {n}")
+    if world <= 0:
+        raise ValueError(f"world must be >= 1, got {world}")
+    if drop_last:
+        if n < world:
+            raise ValueError(
+                f"drop_last=True requires n >= world (n={n}, world={world})"
+            )
+        num_samples = n // world
+    else:
+        num_samples = math.ceil(n / world)
+    return num_samples, num_samples * world
+
+
+def rank_positions(xp: Any, n: int, rank, world: int, num_samples: int,
+                   partition: str, pos_dtype):
+    """Global stream positions owned by ``rank``, wrapped mod n.
+
+    strided: ``rank, rank+world, ...``   (torch law, distributed.py:134 [T])
+    blocked: ``rank*num_samples + [0, num_samples)`` (contiguous; better
+             locality when the underlying storage is range-sharded)
+    """
+    ar = xp.arange(num_samples, dtype=pos_dtype)
+    rank_p = xp.asarray(rank).astype(pos_dtype)
+    if partition == "strided":
+        p = rank_p + xp.asarray(world, dtype=pos_dtype) * ar
+    elif partition == "blocked":
+        p = rank_p * xp.asarray(num_samples, dtype=pos_dtype) + ar
+    else:
+        raise ValueError(f"partition must be 'strided' or 'blocked', got {partition!r}")
+    return p % xp.asarray(n, dtype=pos_dtype)
+
+
+def epoch_indices_generic(
+    xp: Any,
+    n: int,
+    window: int,
+    seed,
+    epoch,
+    rank,
+    world: int,
+    *,
+    shuffle: bool = True,
+    drop_last: bool = False,
+    order_windows: bool = True,
+    partition: str = "strided",
+    rounds: int = DEFAULT_ROUNDS,
+):
+    """The pure function at the heart of the framework (SURVEY.md §7).
+
+    Returns rank's epoch indices as an array of length ``num_samples`` with
+    dtype int32 (n < 2^31) or int64.  Deterministic in
+    ``(n, window, seed, epoch, rank, world, flags)`` — no state, no
+    communication, random-access (mid-epoch resume is a slice).
+    """
+    num_samples, _total = shard_sizes(n, world, drop_last)
+    pos_dtype = xp.uint32 if n <= 0x7FFFFFFF else xp.uint64
+    out_dtype = xp.int32 if n <= 0x7FFFFFFF else xp.int64
+    p = rank_positions(xp, n, rank, world, num_samples, partition, pos_dtype)
+    if not shuffle:
+        return p.astype(out_dtype)
+    ek = derive_epoch_key(xp, seed, epoch)
+    idx = windowed_perm(
+        xp, p, n, window, ek,
+        order_windows=order_windows, rounds=rounds, pos_dtype=pos_dtype,
+    )
+    return idx.astype(out_dtype)

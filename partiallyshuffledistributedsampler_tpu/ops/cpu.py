@@ -1,0 +1,66 @@
+"""CPU reference backend — numpy implementation of the spec in ops/core.py.
+
+This is the framework's ground truth: the XLA backend (ops/xla.py), the
+Pallas kernel (ops/pallas_kernel.py) and the native C++ path (csrc/) must all
+be bit-identical to this.  It plays the role of the reference's host-side
+index generation (BASELINE.json: "host-side torch.randperm") but is already
+windowed — the honest CPU comparator named in BASELINE.md.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import core
+
+
+def epoch_indices_np(
+    n: int,
+    window: int,
+    seed: int,
+    epoch: int,
+    rank: int,
+    world: int,
+    *,
+    shuffle: bool = True,
+    drop_last: bool = False,
+    order_windows: bool = True,
+    partition: str = "strided",
+    rounds: int = core.DEFAULT_ROUNDS,
+) -> np.ndarray:
+    """Rank's epoch indices on the host.  int32[num_samples] (int64 if n>=2^31)."""
+    if not (0 <= rank < world):
+        raise ValueError(f"rank must be in [0, {world}), got {rank}")
+    return core.epoch_indices_generic(
+        np, n, window, int(seed), int(epoch), int(rank), world,
+        shuffle=shuffle, drop_last=drop_last, order_windows=order_windows,
+        partition=partition, rounds=rounds,
+    )
+
+
+def full_epoch_stream_np(
+    n: int,
+    window: int,
+    seed: int,
+    epoch: int,
+    *,
+    world: int = 1,
+    drop_last: bool = False,
+    order_windows: bool = True,
+    rounds: int = core.DEFAULT_ROUNDS,
+) -> np.ndarray:
+    """The entire padded epoch stream (all ranks interleaved) — test utility.
+
+    Equals ``concat interleave`` of every rank's strided shard; used by the
+    invariant tests to check the partition property without instantiating
+    ``world`` samplers.
+    """
+    num_samples, total = core.shard_sizes(n, world, drop_last)
+    pos_dtype = np.uint32 if n <= 0x7FFFFFFF else np.uint64
+    p = np.arange(total, dtype=pos_dtype) % np.asarray(n, dtype=pos_dtype)
+    ek = core.derive_epoch_key(np, int(seed), int(epoch))
+    out_dtype = np.int32 if n <= 0x7FFFFFFF else np.int64
+    return core.windowed_perm(
+        np, p, n, window, ek, order_windows=order_windows, rounds=rounds,
+        pos_dtype=pos_dtype,
+    ).astype(out_dtype)

@@ -1,0 +1,106 @@
+"""XLA backend — the on-device index generator (the north-star component).
+
+Replaces the reference's host-side ``torch.randperm`` epoch regen
+(BASELINE.json north_star [B]) with a jitted pure function that emits the
+rank's shuffled index tensor directly in HBM.  Static configuration
+(n, window, world, flags) is baked into the compilation; (seed, epoch, rank)
+are traced uint32 scalars, so *every epoch reuses one compiled executable* —
+`set_epoch` costs one async dispatch, not a recompile.
+
+Bit-identical to ops/cpu.py by construction: both run the uint32 program in
+ops/core.py.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from . import core
+
+
+@functools.lru_cache(maxsize=None)
+def _compiled_epoch_indices(
+    n: int,
+    window: int,
+    world: int,
+    shuffle: bool,
+    drop_last: bool,
+    order_windows: bool,
+    partition: str,
+    rounds: int,
+    use_pallas: bool,
+):
+    """One compiled executable per static config, cached for the process."""
+    if n > 0x7FFFFFFF and not jax.config.read("jax_enable_x64"):
+        raise ValueError(
+            "index spaces >= 2^31 need uint64 position math: enable x64 "
+            "(jax.config.update('jax_enable_x64', True) or "
+            "partiallyshuffledistributedsampler_tpu.enable_big_index_space())"
+        )
+
+    if use_pallas:
+        from . import pallas_kernel
+
+        def fn(seed_lo, seed_hi, epoch, rank):
+            return pallas_kernel.epoch_indices_pallas(
+                n, window, (seed_lo, seed_hi), epoch, rank, world,
+                shuffle=shuffle, drop_last=drop_last,
+                order_windows=order_windows, partition=partition,
+                rounds=rounds,
+            )
+    else:
+        def fn(seed_lo, seed_hi, epoch, rank):
+            return core.epoch_indices_generic(
+                jnp, n, window, (seed_lo, seed_hi), epoch, rank, world,
+                shuffle=shuffle, drop_last=drop_last,
+                order_windows=order_windows, partition=partition,
+                rounds=rounds,
+            )
+
+    return jax.jit(fn)
+
+
+def epoch_indices_jax(
+    n: int,
+    window: int,
+    seed,
+    epoch,
+    rank,
+    world: int,
+    *,
+    shuffle: bool = True,
+    drop_last: bool = False,
+    order_windows: bool = True,
+    partition: str = "strided",
+    rounds: int = core.DEFAULT_ROUNDS,
+    use_pallas: bool = False,
+) -> jax.Array:
+    """Rank's epoch indices as a device array (int32, or int64 when n>=2^31).
+
+    (seed, epoch, rank) may be python ints or traced scalars; they are passed
+    as uint32 so the executable is reused across epochs and ranks.  The
+    result lives in HBM; dispatch is async — callers overlap the regen with
+    the tail of the previous epoch for free.
+    """
+    import numpy as np
+
+    fn = _compiled_epoch_indices(
+        int(n), int(window), int(world), bool(shuffle), bool(drop_last),
+        bool(order_windows), str(partition), int(rounds), bool(use_pallas),
+    )
+    if isinstance(rank, (int, np.integer)) and not (0 <= int(rank) < world):
+        # traced ranks legitimately can't be checked; concrete ones must be —
+        # an out-of-range rank would silently alias another rank's shard
+        raise ValueError(f"rank must be in [0, {world}), got {int(rank)}")
+    to_u32 = lambda v: jnp.asarray(v).astype(jnp.uint32)
+    if isinstance(seed, (int, np.integer)):
+        seed = int(seed)
+        seed_lo, seed_hi = seed & 0xFFFFFFFF, (seed >> 32) & 0xFFFFFFFF
+    elif isinstance(seed, tuple):
+        seed_lo, seed_hi = seed
+    else:
+        seed_lo, seed_hi = seed, 0
+    return fn(to_u32(seed_lo), to_u32(seed_hi), to_u32(epoch), to_u32(rank))

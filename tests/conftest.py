@@ -1,0 +1,20 @@
+"""Test bootstrap: force a virtual 8-device CPU platform BEFORE jax imports.
+
+SURVEY.md §4 invariant 8: multi-device semantics are testable without a pod
+via ``--xla_force_host_platform_device_count``.  The environment ships
+``JAX_PLATFORMS=axon`` (one emulated TPU); tests override to CPU for speed
+and parallelism-under-test.  bench.py and __graft_entry__.py do NOT import
+this and keep the real device.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,62 @@
+"""CPU-vs-XLA bit-identity (SURVEY.md §4 invariant 8 / BASELINE north star).
+
+The XLA backend must reproduce the numpy reference EXACTLY for every driver
+config shape.  Because both backends execute the same uint32 program
+(ops/core.py), any divergence is a bug in one of the wrappers, not a
+tolerance question — hence assert_array_equal, never allclose.
+"""
+
+import numpy as np
+import pytest
+
+from partiallyshuffledistributedsampler_tpu.ops import cpu
+from partiallyshuffledistributedsampler_tpu.ops.xla import epoch_indices_jax
+
+# Mirrors BASELINE.json "configs" shapes at test scale: CIFAR-ish/window 512,
+# ImageNet-ish/window 8192 (scaled), shard-mode-ish small n, awkward remainders.
+CONFIGS = [
+    dict(n=50_000, window=512, world=2),          # CIFAR-10, 2 ranks
+    dict(n=10_000, window=8192, world=8),         # window ~ n/1 regime
+    dict(n=12_345, window=512, world=8),          # remainders everywhere
+    dict(n=640, window=64, world=8, drop_last=True),
+    dict(n=1000, window=1, world=3),
+    dict(n=1000, window=2048, world=3),           # W > n
+    dict(n=97, window=10, world=3, partition="blocked"),
+    dict(n=5000, window=100, world=4, order_windows=False),
+    dict(n=777, window=33, world=5, shuffle=False),
+]
+
+
+@pytest.mark.parametrize("cfg", CONFIGS, ids=lambda c: f"n{c['n']}w{c['window']}x{c['world']}")
+@pytest.mark.parametrize("seed,epoch", [(0, 0), (1234, 7), ((1 << 40) + 5, 2)])
+def test_bit_identical(cfg, seed, epoch):
+    cfg = dict(cfg)
+    n, w, world = cfg.pop("n"), cfg.pop("window"), cfg.pop("world")
+    for rank in range(0, world, max(1, world // 3)):
+        ref = cpu.epoch_indices_np(n, w, seed, epoch, rank, world, **cfg)
+        got = np.asarray(epoch_indices_jax(n, w, seed, epoch, rank, world, **cfg))
+        assert got.dtype == ref.dtype
+        np.testing.assert_array_equal(got, ref)
+
+
+def test_traced_scalars_match_python_ints():
+    """(seed, epoch, rank) must be traceable — one executable for all epochs."""
+    import jax.numpy as jnp
+
+    ref = cpu.epoch_indices_np(1000, 64, 5, 3, 1, 4)
+    got = np.asarray(
+        epoch_indices_jax(
+            1000, 64, jnp.uint32(5), jnp.uint32(3), jnp.uint32(1), 4
+        )
+    )
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_no_recompile_across_epochs():
+    """set_epoch must not trigger retracing: the jitted fn is cached per
+    static config and (seed, epoch, rank) are traced args."""
+    from partiallyshuffledistributedsampler_tpu.ops import xla as xla_mod
+
+    f1 = xla_mod._compiled_epoch_indices(2048, 128, 4, True, False, True, "strided", 24, False)
+    f2 = xla_mod._compiled_epoch_indices(2048, 128, 4, True, False, True, "strided", 24, False)
+    assert f1 is f2

@@ -1,0 +1,258 @@
+"""Invariant suite for the windowed epoch permutation (SURVEY.md §4, 1-6).
+
+These are the properties that fully characterise the component: partition,
+determinism, epoch variation, windowing law, degenerate cases, set_epoch
+semantics.  Randomised over (N, W, world, seed, epoch) the way a
+hypothesis-style suite would be, but with an explicit seeded grid so failures
+are reproducible without a shrinker.
+"""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from partiallyshuffledistributedsampler_tpu.ops import core, cpu
+
+# A deliberately awkward grid: primes, exact multiples, W>N, W=1, world>N.
+GRID = [
+    # (n, window, world)
+    (1, 1, 1),
+    (7, 3, 2),
+    (16, 4, 4),
+    (97, 10, 3),
+    (100, 100, 4),
+    (128, 256, 8),      # W > N
+    (1000, 64, 2),
+    (1000, 1, 5),       # W = 1
+    (1023, 512, 7),
+    (4096, 512, 8),
+    (5, 2, 8),          # world > n (wrap-padding repeats)
+]
+SEEDS_EPOCHS = [(0, 0), (42, 3), ((1 << 40) + 7, 1)]
+
+
+def _all_ranks(n, w, world, seed, epoch, **kw):
+    return [
+        cpu.epoch_indices_np(n, w, seed, epoch, r, world, **kw)
+        for r in range(world)
+    ]
+
+
+# ---------------------------------------------------------------- invariant 1
+@pytest.mark.parametrize("n,w,world", GRID)
+@pytest.mark.parametrize("seed,epoch", SEEDS_EPOCHS[:2])
+def test_partition_covers_and_is_balanced(n, w, world, seed, epoch):
+    shards = _all_ranks(n, w, world, seed, epoch)
+    num_samples, total = core.shard_sizes(n, world, drop_last=False)
+    for s in shards:
+        assert len(s) == num_samples
+        assert (s >= 0).all() and (s < n).all()
+    everything = np.concatenate(shards)
+    assert len(everything) == total
+    # multiset == [0, n) wrap-padded to total_size: counts differ by <= the
+    # number of full wraps + 1 and every index appears at least total // n times
+    counts = np.bincount(everything, minlength=n)
+    assert counts.min() >= total // n
+    assert counts.sum() == total
+    assert counts.max() <= -(-total // n)  # ceil
+
+
+@pytest.mark.parametrize("n,w,world", [(1000, 64, 4), (97, 10, 3), (16, 4, 4)])
+def test_partition_disjoint_before_padding(n, w, world):
+    # drop_last=True -> total <= n -> shards must be pairwise disjoint
+    shards = _all_ranks(n, w, world, 5, 2, drop_last=True)
+    everything = np.concatenate(shards)
+    assert len(np.unique(everything)) == len(everything)
+
+
+@pytest.mark.parametrize("n,w,world", [(1000, 64, 3), (97, 16, 2)])
+def test_drop_last_sizes(n, w, world):
+    num_samples, total = core.shard_sizes(n, world, drop_last=True)
+    assert num_samples == n // world
+    assert total == num_samples * world <= n
+
+
+# ---------------------------------------------------------------- invariant 2
+@pytest.mark.parametrize("n,w,world", GRID[:6])
+def test_determinism(n, w, world):
+    a = cpu.epoch_indices_np(n, w, 9, 4, 0, world)
+    b = cpu.epoch_indices_np(n, w, 9, 4, 0, world)
+    np.testing.assert_array_equal(a, b)
+
+
+# ---------------------------------------------------------------- invariant 3
+@pytest.mark.parametrize("n,w", [(1000, 64), (4096, 512), (97, 10)])
+def test_epoch_variation(n, w):
+    a = cpu.epoch_indices_np(n, w, 1, 0, 0, 1)
+    b = cpu.epoch_indices_np(n, w, 1, 1, 0, 1)
+    assert (a != b).mean() > 0.5
+
+
+@pytest.mark.parametrize("n,w", [(1000, 64)])
+def test_seed_variation(n, w):
+    a = cpu.epoch_indices_np(n, w, 1, 0, 0, 1)
+    b = cpu.epoch_indices_np(n, w, 2, 0, 0, 1)
+    assert (a != b).mean() > 0.5
+
+
+def test_big_seed_bits_matter():
+    # seeds differing only above bit 32 must give different permutations
+    a = cpu.epoch_indices_np(1000, 64, 7, 0, 0, 1)
+    b = cpu.epoch_indices_np(1000, 64, 7 + (1 << 35), 0, 0, 1)
+    assert (a != b).mean() > 0.5
+
+
+# ---------------------------------------------------------------- invariant 4
+@pytest.mark.parametrize("n,w", [(1000, 64), (1023, 512), (97, 10), (4096, 512)])
+@pytest.mark.parametrize("order_windows", [True, False])
+def test_windowing_law(n, w, order_windows):
+    """THE reference-specific property, as fixed by SPEC.md:
+
+    the epoch stream, cut into consecutive W-sized output slots, has each
+    slot equal (as a set) to exactly one source window; the trailing partial
+    window stays last; with order_windows=False slot j draws from window j.
+    """
+    stream = cpu.full_epoch_stream_np(n, w, 3, 1, order_windows=order_windows)
+    nw_full = n // w
+    seen = []
+    for j in range(nw_full):
+        blk = np.sort(stream[j * w:(j + 1) * w])
+        k = blk[0] // w
+        seen.append(k)
+        np.testing.assert_array_equal(blk, np.arange(k * w, (k + 1) * w))
+        if not order_windows:
+            assert k == j
+    assert sorted(seen) == list(range(nw_full))
+    tail = np.sort(stream[nw_full * w: n])
+    np.testing.assert_array_equal(tail, np.arange(nw_full * w, n))
+
+
+def test_window_order_actually_shuffles():
+    stream = cpu.full_epoch_stream_np(10000, 100, 3, 1, order_windows=True)
+    slots = stream.reshape(100, 100)
+    src = slots.min(axis=1) // 100
+    assert (src != np.arange(100)).mean() > 0.5
+
+
+def test_displacement_bounded_without_window_order():
+    # order_windows=False: every index stays within its own window span ->
+    # |pi(p) - p| < W.  This is the locality guarantee partial shuffle sells.
+    n, w = 10000, 128
+    stream = cpu.full_epoch_stream_np(n, w, 11, 2, order_windows=False)
+    disp = np.abs(stream.astype(np.int64) - np.arange(n))
+    assert disp.max() < w
+
+
+# ---------------------------------------------------------------- invariant 5
+def test_no_shuffle_is_sequential():
+    idx = cpu.epoch_indices_np(100, 16, 5, 9, 0, 1, shuffle=False)
+    np.testing.assert_array_equal(idx, np.arange(100))
+
+
+def test_no_shuffle_rank_slice():
+    i1 = cpu.epoch_indices_np(100, 16, 5, 9, 1, 4, shuffle=False)
+    np.testing.assert_array_equal(i1, np.arange(1, 100, 4))
+
+
+def test_w_geq_n_is_full_shuffle():
+    # W >= N must behave like a full (unwindowed) permutation of [0, n)
+    for w in (1000, 1024, 10_000):
+        stream = cpu.full_epoch_stream_np(1000, w, 7, 0)
+        assert sorted(stream.tolist()) == list(range(1000))
+        # and it really is shuffled across the whole range, not block-local
+        disp = np.abs(stream.astype(np.int64) - np.arange(1000))
+        assert disp.max() > 500
+
+
+def test_w1_no_intra_window_shuffle():
+    # W=1: windows are singletons; only window order can move.  With
+    # order_windows=False the stream must be the identity.
+    stream = cpu.full_epoch_stream_np(100, 1, 7, 0, order_windows=False)
+    np.testing.assert_array_equal(stream, np.arange(100))
+
+
+def test_uneven_world_padding():
+    # n not divisible by world, no drop_last: wrap-padding with stream head
+    n, world = 10, 4
+    shards = _all_ranks(n, 100, world, 0, 0)  # W > n -> full shuffle, simpler
+    num_samples, total = core.shard_sizes(n, world, False)
+    assert num_samples == 3 and total == 12
+    stream = cpu.full_epoch_stream_np(n, 100, 0, 0, world=world)
+    assert len(stream) == 12
+    np.testing.assert_array_equal(stream[10:], stream[:2])  # wrap law
+
+
+# ---------------------------------------------------------------- invariant 6
+def test_set_epoch_semantics():
+    # same epoch twice -> identical; bumping epoch -> different.  (The torch
+    # shim's set_epoch stores e; the law lives in the pure function.)
+    a0 = cpu.epoch_indices_np(512, 32, 1, 0, 0, 2)
+    a0_again = cpu.epoch_indices_np(512, 32, 1, 0, 0, 2)
+    a1 = cpu.epoch_indices_np(512, 32, 1, 1, 0, 2)
+    np.testing.assert_array_equal(a0, a0_again)
+    assert (a0 != a1).any()
+
+
+# ------------------------------------------------------------------- blocked
+def test_blocked_partition_covers():
+    n, world = 1000, 4
+    shards = [
+        cpu.epoch_indices_np(n, 64, 3, 0, r, world, partition="blocked")
+        for r in range(world)
+    ]
+    everything = np.sort(np.concatenate(shards))
+    np.testing.assert_array_equal(everything, np.arange(n))
+
+
+def test_blocked_equals_stream_blocks():
+    n, world = 1000, 4
+    stream = cpu.full_epoch_stream_np(n, 64, 3, 0, world=world)
+    num_samples, _ = core.shard_sizes(n, world, False)
+    for r in range(world):
+        blk = cpu.epoch_indices_np(n, 64, 3, 0, r, world, partition="blocked")
+        np.testing.assert_array_equal(
+            blk, stream[r * num_samples:(r + 1) * num_samples]
+        )
+
+
+# ------------------------------------------------------------------ validity
+def test_rank_range_validated():
+    with pytest.raises(ValueError):
+        cpu.epoch_indices_np(10, 4, 0, 0, 5, 4)
+    with pytest.raises(ValueError):
+        cpu.epoch_indices_np(10, 4, 0, 0, -1, 4)
+
+
+def test_bad_sizes_rejected():
+    with pytest.raises(ValueError):
+        core.shard_sizes(0, 1, False)
+    with pytest.raises(ValueError):
+        core.shard_sizes(10, 0, False)
+    with pytest.raises(ValueError):
+        core.shard_sizes(3, 8, True)  # drop_last with n < world
+
+
+def test_golden_epoch_indices_frozen():
+    """Spec freeze for the full pipeline (keys + windowing + rank slice)."""
+    got = cpu.epoch_indices_np(1000, 64, 42, 3, 1, 4)[:8].tolist()
+    assert got == [706, 727, 713, 733, 717, 766, 744, 716]
+    got_big_seed = cpu.epoch_indices_np(500, 32, (1 << 40) + 7, 1, 0, 1)[:8].tolist()
+    assert got_big_seed == [91, 90, 77, 69, 83, 67, 95, 79]
+
+
+def test_randomized_sweep():
+    rng = np.random.default_rng(0)
+    for _ in range(25):
+        n = int(rng.integers(1, 3000))
+        w = int(rng.integers(1, 700))
+        world = int(rng.integers(1, 9))
+        seed = int(rng.integers(0, 2**63))
+        epoch = int(rng.integers(0, 1000))
+        shards = _all_ranks(n, w, world, seed, epoch)
+        num_samples, total = core.shard_sizes(n, world, False)
+        everything = np.concatenate(shards)
+        counts = np.bincount(everything, minlength=n)
+        assert counts.sum() == total
+        assert counts.min() >= total // n
+        assert counts.max() <= -(-total // n)
