@@ -1,0 +1,9 @@
+"""Consumer models demonstrating the sampler end-to-end on a device mesh."""
+
+from .gpt import GPTConfig, MiniGPT, forward, init_params  # noqa: F401
+from .train import (  # noqa: F401
+    create_sharded_state,
+    demo_training_run,
+    make_mesh,
+    make_train_step,
+)

@@ -1,0 +1,92 @@
+"""Flagship consumer model: a GPT-2-style decoder in flax.linen.
+
+The reference is a sampler library with no model zoo (SURVEY.md §0.5); the
+driver configs [B] nonetheless name the *consumers* the sampler feeds
+(GPT-2-small on C4, ResNet/ViT on images, Llama-3 pretrain).  This mini-GPT
+is the framework's end-to-end demonstration vehicle: the training step in
+``models/train.py`` consumes sampler indices ENTIRELY on device — the epoch
+index tensor lives in HBM, per-step batches are dynamic-sliced and gathered
+inside the jitted step, and the model itself is sharded dp x tp over a mesh.
+
+TPU-first choices: bfloat16 activations by default (MXU-native), static
+shapes everywhere, fused QKV projection (one big matmul beats three small
+ones on the systolic array), no data-dependent control flow.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 512
+    seq_len: int = 64
+    d_model: int = 128
+    n_layers: int = 2
+    n_heads: int = 4
+    d_ff: int = 512
+    dtype: Any = jnp.bfloat16  # activations; params stay f32 for optimizer
+
+
+class Block(nn.Module):
+    cfg: GPTConfig
+
+    @nn.compact
+    def __call__(self, x):
+        c = self.cfg
+        h = nn.LayerNorm(dtype=c.dtype, name="ln1")(x)
+        qkv = nn.Dense(3 * c.d_model, dtype=c.dtype, name="qkv")(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        B, T, D = q.shape
+        hd = D // c.n_heads
+        q = q.reshape(B, T, c.n_heads, hd).transpose(0, 2, 1, 3)
+        k = k.reshape(B, T, c.n_heads, hd).transpose(0, 2, 1, 3)
+        v = v.reshape(B, T, c.n_heads, hd).transpose(0, 2, 1, 3)
+        att = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.sqrt(hd).astype(c.dtype)
+        mask = jnp.tril(jnp.ones((T, T), dtype=bool))
+        att = jnp.where(mask, att, jnp.finfo(c.dtype).min)
+        att = jax.nn.softmax(att.astype(jnp.float32), axis=-1).astype(c.dtype)
+        out = jnp.einsum("bhqk,bhkd->bhqd", att, v)
+        out = out.transpose(0, 2, 1, 3).reshape(B, T, D)
+        x = x + nn.Dense(c.d_model, dtype=c.dtype, name="proj")(out)
+        h2 = nn.LayerNorm(dtype=c.dtype, name="ln2")(x)
+        ff = nn.Dense(c.d_ff, dtype=c.dtype, name="fc1")(h2)
+        ff = nn.gelu(ff)
+        x = x + nn.Dense(c.d_model, dtype=c.dtype, name="fc2")(ff)
+        return x
+
+
+class MiniGPT(nn.Module):
+    cfg: GPTConfig
+
+    @nn.compact
+    def __call__(self, tokens):
+        c = self.cfg
+        x = nn.Embed(c.vocab_size, c.d_model, dtype=c.dtype, name="wte")(tokens)
+        pos = nn.Embed(c.seq_len, c.d_model, dtype=c.dtype, name="wpe")(
+            jnp.arange(tokens.shape[1])
+        )
+        x = x + pos[None]
+        for i in range(c.n_layers):
+            x = Block(c, name=f"block{i}")(x)
+        x = nn.LayerNorm(dtype=c.dtype, name="lnf")(x)
+        # weight-tied LM head would save params; keep a separate head so the
+        # tp sharding of the embedding and the head can differ
+        logits = nn.Dense(c.vocab_size, dtype=jnp.float32, name="head")(x)
+        return logits
+
+
+def init_params(cfg: GPTConfig, key) -> Any:
+    model = MiniGPT(cfg)
+    tokens = jnp.zeros((1, cfg.seq_len), jnp.int32)
+    return model.init(key, tokens)["params"]
+
+
+def forward(cfg: GPTConfig, params, tokens) -> jax.Array:
+    return MiniGPT(cfg).apply({"params": params}, tokens)

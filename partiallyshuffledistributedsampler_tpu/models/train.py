@@ -1,0 +1,146 @@
+"""End-to-end sharded training step consuming on-device sampler indices.
+
+This is the integration story the north star describes: ``set_epoch`` regens
+the epoch's index tensor in HBM (ICI seed agreement included), and the
+training step gathers its per-step batch from those device-resident indices
+— the host never touches an index.  The model is sharded dp x tp over a
+``jax.sharding.Mesh`` (Megatron-style column/row parallel linears via GSPMD
+sharding hints); pp/sp/ep are N/A for a sampler framework (SURVEY.md §2
+parallelism inventory) — the data axis is the one the sampler partitions.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .gpt import GPTConfig, forward, init_params
+from ..parallel.sharded import sharded_epoch_indices
+
+
+def make_mesh(n_devices: Optional[int] = None, tp: Optional[int] = None) -> Mesh:
+    """A (dp, tp) mesh over the first ``n_devices`` devices."""
+    devs = jax.devices() if n_devices is None else jax.devices()[:n_devices]
+    n = len(devs)
+    if tp is None:
+        tp = 2 if n % 2 == 0 and n >= 2 else 1
+    if n % tp:
+        raise ValueError(f"{n} devices not divisible by tp={tp}")
+    return Mesh(np.asarray(devs).reshape(n // tp, tp), ("dp", "tp"))
+
+
+def _spec_for(path: str, shape) -> P:
+    """Megatron-style placement: column-parallel qkv/fc1/head (shard the
+    output features over tp), row-parallel proj/fc2 (shard the input
+    features), embeddings sharded over d_model, everything 1-D replicated.
+    GSPMD inserts the matching collectives; hints only affect layout."""
+    if len(shape) < 2:
+        return P()  # biases, layernorm scales
+    if any(k in path for k in ("qkv", "fc1", "head")):
+        return P(None, "tp")
+    if any(k in path for k in ("proj", "fc2")):
+        return P("tp", None)
+    if "wte" in path or "wpe" in path:
+        return P(None, "tp")
+    return P()
+
+
+def param_shardings(mesh: Mesh, params) -> Any:
+    def leaf(path, x):
+        keys = "/".join(str(getattr(p, "key", p)) for p in path)
+        return NamedSharding(mesh, _spec_for(keys, x.shape))
+
+    return jax.tree_util.tree_map_with_path(leaf, params)
+
+
+def create_sharded_state(cfg: GPTConfig, mesh: Mesh, seed: int = 0):
+    """Init params on host, place them sharded; build the optimizer state
+    under jit so it inherits the params' sharding leaf-for-leaf."""
+    params = init_params(cfg, jax.random.PRNGKey(seed))
+    shardings = param_shardings(mesh, params)
+    params = jax.device_put(params, shardings)
+    tx = optax.adamw(3e-4)
+    # eager init: zeros_like follows each param's placement, so the optimizer
+    # state is sharded leaf-for-leaf like the params (jit would need explicit
+    # out_shardings to guarantee the same)
+    opt_state = tx.init(params)
+    return params, opt_state, tx
+
+
+def make_train_step(cfg: GPTConfig, tx, mesh: Mesh, batch_per_dp: int):
+    """Jitted full training step.
+
+    Signature: ``(params, opt_state, tokens, epoch_idx, step) ->
+    (params, opt_state, loss)`` where ``epoch_idx`` is the mesh-sharded
+    [dp, num_samples] index tensor from ``sharded_epoch_indices`` and
+    ``tokens`` the (replicated) token table [n, seq+1].  The batch gather
+    happens on device: dynamic-slice the step's index window, take rows.
+    """
+    dp = mesh.shape["dp"]
+
+    def loss_fn(params, batch):
+        logits = forward(cfg, params, batch[:, :-1])
+        targets = batch[:, 1:]
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        return -ll.mean()
+
+    def step_fn(params, opt_state, tokens, epoch_idx, step):
+        # per-step index window for every dp rank: [dp, batch_per_dp]
+        win = jax.lax.dynamic_slice(
+            epoch_idx,
+            (0, step * batch_per_dp),
+            (dp, batch_per_dp),
+        )
+        batch = tokens[win.reshape(-1)]  # [dp*batch_per_dp, seq+1]
+        batch = jax.lax.with_sharding_constraint(
+            batch, NamedSharding(mesh, P("dp", None))
+        )
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return jax.jit(step_fn, donate_argnums=(0, 1))
+
+
+def demo_training_run(
+    mesh: Mesh,
+    cfg: Optional[GPTConfig] = None,
+    *,
+    n_samples: int = 512,
+    window: int = 64,
+    batch_per_dp: int = 4,
+    steps_per_epoch: int = 2,
+    epochs: int = 2,
+    seed: int = 0,
+) -> list:
+    """The minimum end-to-end slice (SURVEY.md §7 build order #3, scaled to
+    the test mesh): synthetic token dataset -> per-epoch on-device regen with
+    ICI seed agreement -> sharded train steps.  Returns per-step losses."""
+    cfg = cfg or GPTConfig()
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(seed + 1), (n_samples, cfg.seq_len + 1), 0,
+        cfg.vocab_size, dtype=jnp.int32,
+    )
+    params, opt_state, tx = create_sharded_state(cfg, mesh, seed)
+    step = make_train_step(cfg, tx, mesh, batch_per_dp)
+    losses = []
+    for epoch in range(epochs):
+        # the set_epoch moment: one fused XLA program agrees on the seed over
+        # ICI and emits every dp rank's shard in its own HBM
+        idx = sharded_epoch_indices(
+            mesh, n_samples, window, seed, epoch, axis="dp"
+        )
+        for s in range(steps_per_epoch):
+            params, opt_state, loss = step(
+                params, opt_state, tokens, idx, jnp.int32(s)
+            )
+            losses.append(float(loss))
+    return losses

@@ -100,6 +100,24 @@ def mix32(xp: Any, x):
 # Key schedule
 # ---------------------------------------------------------------------------
 
+def fold_seed(seed) -> tuple:
+    """Normalize a seed into the spec's (lo, hi) uint32 pair (SPEC.md §1).
+
+    Accepts python/numpy ints of any size (hi/lo split), an existing
+    (lo, hi) pair (passed through), or a traced uint32 scalar (hi = 0).
+    Single source of truth — every backend folds seeds through here so a
+    change can never desynchronize them.
+    """
+    import numpy as _np
+
+    if isinstance(seed, (int, _np.integer)):
+        s = int(seed)
+        return (s & _M32, (s >> 32) & _M32)
+    if isinstance(seed, tuple):
+        return seed
+    return (seed, 0)
+
+
 def derive_epoch_key(xp: Any, seed, epoch):
     """Fold ``(seed, epoch)`` into the epoch master key (uint32).
 
@@ -111,16 +129,9 @@ def derive_epoch_key(xp: Any, seed, epoch):
     """
     import numpy as _np  # concrete-int normalization; never traces
 
-    if isinstance(seed, (int, _np.integer)):
-        seed = int(seed)
-        seed_lo = _u32(xp, seed & _M32)
-        seed_hi = _u32(xp, (seed >> 32) & _M32)
-    elif isinstance(seed, tuple):  # (lo, hi) pair, each int or traced uint32
-        seed_lo = xp.asarray(seed[0]).astype(xp.uint32)
-        seed_hi = xp.asarray(seed[1]).astype(xp.uint32)
-    else:  # traced/array scalar seed: uint32 lo, hi=0
-        seed_lo = xp.asarray(seed).astype(xp.uint32)
-        seed_hi = _u32(xp, 0)
+    lo, hi = fold_seed(seed)
+    seed_lo = xp.asarray(lo).astype(xp.uint32)
+    seed_hi = xp.asarray(hi).astype(xp.uint32)
     if isinstance(epoch, (int, _np.integer)):
         ep = _u32(xp, int(epoch) & _M32)
     else:
@@ -190,7 +201,8 @@ def swap_or_not(xp: Any, x, m: int, key, rounds: int, pair_key=None):
         k_r = mix32(xp, pair_key ^ _u32(xp, (r * _GOLDEN) & _M32)) % m_u
         partner = k_r + (m_u - x)
         partner = xp.where(partner >= m_u, partner - m_u, partner)
-        c = xp.maximum(x, partner)
+        # unsigned max via select — Mosaic has no arith.maxui vector lowering
+        c = xp.where(x > partner, x, partner)
         b = mix32(xp, c ^ key2 ^ _u32(xp, (r * _RC_BIT) & _M32))
         x = xp.where((b & _u32(xp, 1)) == _u32(xp, 1), partner, x)
     return x
@@ -239,7 +251,8 @@ def windowed_perm(
     if nw_full > 0:
         j = (p // W_p).astype(xp.uint32)
         # clip tail lanes into domain; masked out at the end
-        j = xp.minimum(j, _u32(xp, nw_full - 1))
+        lim = _u32(xp, nw_full - 1)
+        j = xp.where(j > lim, lim, j)  # unsigned min via select (Mosaic-safe)
         r0 = (p % W_p).astype(xp.uint32)
         if order_windows and nw_full > 1:
             k = swap_or_not(xp, j, nw_full, outer_key(xp, epoch_key), rounds)
@@ -254,7 +267,9 @@ def windowed_perm(
     if tail_len > 0:
         body_len_p = xp.asarray(body_len, dtype=pos_dtype)
         tpos = xp.where(p >= body_len_p, p - body_len_p, xp.asarray(0, dtype=pos_dtype))
-        tpos32 = xp.minimum(tpos.astype(xp.uint32), _u32(xp, tail_len - 1))
+        tlim = _u32(xp, tail_len - 1)
+        tpos32 = tpos.astype(xp.uint32)
+        tpos32 = xp.where(tpos32 > tlim, tlim, tpos32)
         rho_t = swap_or_not(xp, tpos32, tail_len, tail_key(xp, epoch_key), rounds)
         tail_idx = body_len_p + rho_t.astype(pos_dtype)
         if nw_full > 0:

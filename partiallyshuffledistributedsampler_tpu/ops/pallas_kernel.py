@@ -1,0 +1,161 @@
+"""Fused Pallas TPU kernel for epoch-index generation.
+
+This is the framework's native hot-path component (SURVEY.md §2: the
+reference's only compute-heavy op lives in torch's C++ ``randperm`` kernel;
+ours is a TPU kernel).  One ``pallas_call`` produces the rank's entire
+shuffled index tensor in HBM: each grid program materialises an (8, 128)
+uint32 tile of output positions with ``broadcasted_iota`` (VPU-shaped — 8
+sublanes x 128 lanes), applies the SPEC.md permutation law, and writes the
+tile.  There is no input to read — the kernel is pure compute over an
+implicit iota, so the only HBM traffic is the final index write
+(4 bytes/sample), which makes it memory-optimal for the op.
+
+Bit-identity with the CPU/XLA backends is by construction: the kernel body
+calls the SAME ``ops.core`` uint32 program (jnp ops lower to Mosaic inside a
+kernel), not a re-implementation.
+
+Scope: ``n <= int32 max`` (the XLA path covers the uint64/10B-sample regime;
+a Pallas uint64 path is pointless there because x64 position math dominates
+and XLA already fuses it well).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from . import core
+
+_SUBLANES = 8
+_LANES = 128
+_TILE = _SUBLANES * _LANES  # one program's output elements
+
+
+def _index_kernel(
+    scalar_ref,  # SMEM uint32[1, 4]: (seed_lo, seed_hi, epoch, rank)
+    out_ref,     # VMEM int32[8, 128] tile of the output
+    *,
+    n: int,
+    window: int,
+    world: int,
+    num_samples: int,
+    shuffle: bool,
+    order_windows: bool,
+    partition: str,
+    rounds: int,
+):
+    seed_lo = scalar_ref[0, 0]
+    seed_hi = scalar_ref[0, 1]
+    epoch = scalar_ref[0, 2]
+    rank = scalar_ref[0, 3]
+    i = jnp.asarray(pl.program_id(0)).astype(jnp.uint32)
+
+    row = jax.lax.broadcasted_iota(jnp.uint32, (_SUBLANES, _LANES), 0)
+    col = jax.lax.broadcasted_iota(jnp.uint32, (_SUBLANES, _LANES), 1)
+    flat = i * jnp.uint32(_TILE) + row * jnp.uint32(_LANES) + col
+
+    # Global stream position for this rank (SPEC.md §4).  Lanes with
+    # flat >= num_samples are padding; their (possibly wrapped) garbage is
+    # sliced off by the caller — all math below is closed over [0, 2^32).
+    if partition == "strided":
+        p = rank + jnp.uint32(world) * flat
+    else:  # blocked
+        p = rank * jnp.uint32(num_samples) + flat
+    p = p % jnp.uint32(n)
+
+    if shuffle:
+        ek = core.derive_epoch_key(jnp, (seed_lo, seed_hi), epoch)
+        idx = core.windowed_perm(
+            jnp, p, n, window, ek,
+            order_windows=order_windows, rounds=rounds, pos_dtype=jnp.uint32,
+        )
+    else:
+        idx = p
+    out_ref[:, :] = idx.astype(jnp.int32)
+
+
+@functools.lru_cache(maxsize=None)
+def _build(n, window, world, num_samples, shuffle, order_windows,
+           partition, rounds, interpret):
+    padded = math.ceil(num_samples / _TILE) * _TILE
+    grid = (padded // _TILE,)
+    kernel = functools.partial(
+        _index_kernel,
+        n=n, window=window, world=world, num_samples=num_samples,
+        shuffle=shuffle, order_windows=order_windows,
+        partition=partition, rounds=rounds,
+    )
+    call = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 4), lambda i: (0, 0), memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec((_SUBLANES, _LANES), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((padded // _LANES, _LANES), jnp.int32),
+        cost_estimate=pl.CostEstimate(
+            # ~13 uint32 VPU ops per element per swap-or-not round, 2 active
+            # bijections per element (outer is amortised across a window)
+            flops=padded * rounds * 26,
+            bytes_accessed=padded * 4,
+            transcendentals=0,
+        ),
+        interpret=bool(interpret),
+    )
+
+    def fn(scalars):
+        out = call(scalars)
+        return out.reshape(-1)[:num_samples]
+
+    return fn
+
+
+def epoch_indices_pallas(
+    n: int,
+    window: int,
+    seed,
+    epoch,
+    rank,
+    world: int,
+    *,
+    shuffle: bool = True,
+    drop_last: bool = False,
+    order_windows: bool = True,
+    partition: str = "strided",
+    rounds: int = core.DEFAULT_ROUNDS,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Rank's epoch indices via the fused TPU kernel.  int32[num_samples].
+
+    Same contract as ``epoch_indices_jax`` (which dispatches here under
+    ``use_pallas=True``).  ``interpret`` defaults to auto: compiled Mosaic on
+    a TPU backend, the Pallas interpreter elsewhere (so parity tests run on
+    the CPU test platform).
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if n > 0x7FFFFFFF:
+        raise ValueError(
+            "pallas path supports n <= int32 max; use the XLA backend with "
+            "enable_big_index_space() for larger index spaces"
+        )
+    if partition not in ("strided", "blocked"):
+        raise ValueError(f"partition must be 'strided' or 'blocked', got {partition!r}")
+    num_samples, _ = core.shard_sizes(n, world, drop_last)
+    fn = _build(
+        int(n), int(window), int(world), int(num_samples), bool(shuffle),
+        bool(order_windows), str(partition), int(rounds), bool(interpret),
+    )
+    seed_lo, seed_hi = core.fold_seed(seed)
+    scalars = jnp.stack(
+        [
+            jnp.asarray(v).astype(jnp.uint32)
+            for v in (seed_lo, seed_hi, epoch, rank)
+        ]
+    ).reshape(1, 4)
+    return fn(scalars)

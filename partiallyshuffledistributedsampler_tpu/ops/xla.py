@@ -96,11 +96,5 @@ def epoch_indices_jax(
         # an out-of-range rank would silently alias another rank's shard
         raise ValueError(f"rank must be in [0, {world}), got {int(rank)}")
     to_u32 = lambda v: jnp.asarray(v).astype(jnp.uint32)
-    if isinstance(seed, (int, np.integer)):
-        seed = int(seed)
-        seed_lo, seed_hi = seed & 0xFFFFFFFF, (seed >> 32) & 0xFFFFFFFF
-    elif isinstance(seed, tuple):
-        seed_lo, seed_hi = seed
-    else:
-        seed_lo, seed_hi = seed, 0
+    seed_lo, seed_hi = core.fold_seed(seed)
     return fn(to_u32(seed_lo), to_u32(seed_hi), to_u32(epoch), to_u32(rank))

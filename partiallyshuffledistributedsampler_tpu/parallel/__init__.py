@@ -1,0 +1,4 @@
+"""Mesh-parallel regen: ICI seed agreement + per-device shard generation."""
+
+from .mesh import data_mesh, ensure_distributed, identity_from_mesh  # noqa: F401
+from .sharded import sharded_epoch_indices  # noqa: F401

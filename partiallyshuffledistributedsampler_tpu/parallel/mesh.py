@@ -1,0 +1,56 @@
+"""Device-mesh and distributed-identity helpers.
+
+The reference resolves identity from ``torch.distributed`` process groups
+(``distributed.py:75-82`` [T], identity-only — no collectives).  The
+TPU-native equivalents:
+
+* identity:  ``jax.distributed`` process index / device count (multi-host),
+  or mesh axis index inside ``shard_map`` (per-device SPMD rank);
+* agreement: an ICI collective (parallel/sharded.py) instead of the
+  host-side "same seed by convention" contract.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def ensure_distributed(coordinator: Optional[str] = None) -> None:
+    """Initialize jax.distributed for multi-host pods (idempotent, no-op when
+    no coordinator is configured).  Must run before any backend-initializing
+    JAX call — so the guard below inspects only env/config, never the
+    backend (jax.process_count() would itself initialize XLA and make
+    initialization impossible)."""
+    addr = coordinator or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if not addr:
+        return  # single-host: nothing to do
+    try:
+        jax.distributed.initialize(coordinator_address=addr)
+    except RuntimeError as exc:
+        if "already" in str(exc).lower():
+            return  # idempotent: someone initialized first
+        raise
+
+
+def data_mesh(
+    n_devices: Optional[int] = None, axis_name: str = "data"
+) -> Mesh:
+    """A 1-D mesh over the data axis — the sampler's natural layout.  The DP
+    axis of a larger model mesh plays the same role (SURVEY.md §2: 'the DP
+    axis generalizes to the JAX device mesh')."""
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.asarray(devs), (axis_name,))
+
+
+def identity_from_mesh(mesh: Mesh, axis_name: str = "data") -> tuple[int, int]:
+    """(world, this_process_first_rank) for host-side bookkeeping.  Inside
+    shard_map each device derives its own rank via lax.axis_index."""
+    world = int(np.prod([mesh.shape[a] for a in (axis_name,)]))
+    return world, jax.process_index() * max(1, world // jax.process_count())

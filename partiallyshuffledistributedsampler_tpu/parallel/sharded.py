@@ -1,0 +1,109 @@
+"""Mesh-sharded epoch regen with ICI seed agreement — the north-star path.
+
+The reference relies on a *convention*: every rank constructs the sampler
+with the same ``seed`` and calls ``set_epoch`` with the same value
+(``distributed.py:40-42`` [T]).  BASELINE.json's north star replaces that
+with a *collective*: "the epoch seed broadcast over ICI so all ranks agree
+without a host barrier".  Here each device contributes its local
+``(seed_lo, seed_hi, epoch)`` triple; one ``psum`` of a rank-0-masked value
+over the mesh axis (an ICI all-reduce, no host involvement) makes rank 0's
+triple authoritative; every device then generates ONLY ITS OWN shard of the
+epoch's indices directly in HBM — O(N/world) per device, no materialized
+global permutation, no gather.
+
+Everything runs under one jit: seed agreement + windowed permutation is a
+single fused XLA program per epoch.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops import core
+
+
+@functools.lru_cache(maxsize=None)
+def _compiled_sharded(
+    mesh: Mesh,
+    axis: str,
+    n: int,
+    window: int,
+    world: int,
+    shuffle: bool,
+    drop_last: bool,
+    order_windows: bool,
+    partition: str,
+    rounds: int,
+):
+    num_samples, _ = core.shard_sizes(n, world, drop_last)
+
+    def per_device(local_triple):
+        # local_triple: uint32[1, 3] — this device's (seed_lo, seed_hi, epoch)
+        rank = jax.lax.axis_index(axis)
+        mine = local_triple[0]
+        # ICI broadcast-from-rank-0 as a masked all-reduce: every device
+        # contributes zeros except rank 0, psum rides the interconnect.
+        masked = jnp.where(rank == 0, mine, jnp.zeros_like(mine))
+        agreed = jax.lax.psum(masked, axis)
+        idx = core.epoch_indices_generic(
+            jnp, n, window, (agreed[0], agreed[1]), agreed[2], rank, world,
+            shuffle=shuffle, drop_last=drop_last, order_windows=order_windows,
+            partition=partition, rounds=rounds,
+        )
+        return idx[None, :]
+
+    from jax import shard_map
+
+    fn = shard_map(
+        per_device,
+        mesh=mesh,
+        in_specs=(P(axis, None),),
+        out_specs=P(axis, None),
+    )
+    in_sharding = NamedSharding(mesh, P(axis, None))
+    return jax.jit(fn, in_shardings=(in_sharding,)), num_samples
+
+
+def sharded_epoch_indices(
+    mesh: Mesh,
+    n: int,
+    window: int,
+    seed,
+    epoch,
+    *,
+    axis: str = "data",
+    shuffle: bool = True,
+    drop_last: bool = False,
+    order_windows: bool = True,
+    partition: str = "strided",
+    rounds: int = core.DEFAULT_ROUNDS,
+    local_seeds=None,
+) -> jax.Array:
+    """All ranks' epoch indices as one mesh-sharded array [world, num_samples].
+
+    Row ``r`` lives in device ``r``'s HBM and equals
+    ``epoch_indices_np(n, window, seed, epoch, r, world)`` bit-exactly.
+    ``seed``/``epoch`` are rank 0's values; ``local_seeds`` (uint32[world, 3])
+    optionally supplies each device's own (seed_lo, seed_hi, epoch) triple to
+    exercise the agreement collective — rank 0's row wins by construction.
+    """
+    world = mesh.shape[axis]
+    fn, _num = _compiled_sharded(
+        mesh, axis, int(n), int(window), int(world), bool(shuffle),
+        bool(drop_last), bool(order_windows), str(partition), int(rounds),
+    )
+    if local_seeds is None:
+        lo, hi = core.fold_seed(seed)
+        triple = np.asarray(
+            [[lo, hi, int(epoch)]] * world, dtype=np.uint32
+        )
+    else:
+        triple = np.asarray(local_seeds, dtype=np.uint32)
+        if triple.shape != (world, 3):
+            raise ValueError(f"local_seeds must be [world={world}, 3]")
+    return fn(triple)

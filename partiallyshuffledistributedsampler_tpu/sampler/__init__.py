@@ -1,0 +1,8 @@
+"""User-facing samplers: the torch shim, JAX-native iterators, shard mode."""
+
+from .jax_iterator import DeviceEpochIterator, batch_index_window  # noqa: F401
+from .shard_mode import (  # noqa: F401
+    PartialShuffleShardSampler,
+    expand_shard_indices,
+)
+from .torch_shim import PartiallyShuffleDistributedSampler  # noqa: F401

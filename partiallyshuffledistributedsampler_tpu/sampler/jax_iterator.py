@@ -1,0 +1,101 @@
+"""JAX-native epoch iteration: indices never leave the device.
+
+The torch shim streams indices to the host because torch Datasets live
+there.  A JAX input pipeline doesn't need that: the epoch index tensor stays
+in HBM and per-step batches are sliced/gathered inside the jitted train step
+(models/train.py does exactly this).  This module packages that pattern for
+standalone use, with double-buffered epoch prefetch.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import core
+from ..ops.xla import epoch_indices_jax
+
+
+def batch_index_window(epoch_idx: jax.Array, step, batch: int) -> jax.Array:
+    """The step's index window as a device array — usable inside jit.
+    ``epoch_idx`` is [num_samples] (one rank) or [dp, num_samples]."""
+    if epoch_idx.ndim == 1:
+        return jax.lax.dynamic_slice(epoch_idx, (step * batch,), (batch,))
+    dp = epoch_idx.shape[0]
+    return jax.lax.dynamic_slice(epoch_idx, (0, step * batch), (dp, batch))
+
+
+class DeviceEpochIterator:
+    """Per-epoch, per-step index windows with next-epoch prefetch.
+
+        it = DeviceEpochIterator(n=1_000_000, window=8192, batch=512,
+                                 seed=0, rank=0, world=8)
+        for epoch in range(E):
+            for idx_batch in it.epoch(epoch):   # device int32[batch]
+                loss = train_step(params, data, idx_batch)
+
+    ``epoch()`` dispatches epoch e+1's regen before yielding e's first batch,
+    so the next epoch's permutation is computed while this epoch trains —
+    regen latency is fully hidden, which is how the "<1 ms" budget becomes
+    "0 ms observed" in a real loop.
+    """
+
+    def __init__(
+        self,
+        n: int,
+        window: int,
+        batch: int,
+        *,
+        seed: int = 0,
+        rank: int = 0,
+        world: int = 1,
+        drop_last_batch: bool = True,
+        prefetch_next_epoch: bool = True,
+        **kwargs,
+    ) -> None:
+        self.n, self.window, self.batch = n, window, batch
+        self.seed, self.rank, self.world = seed, rank, world
+        self.kwargs = kwargs
+        self.num_samples, _ = core.shard_sizes(
+            n, world, kwargs.get("drop_last", False)
+        )
+        if drop_last_batch:
+            self.steps_per_epoch = self.num_samples // batch
+        else:
+            self.steps_per_epoch = -(-self.num_samples // batch)
+        if self.steps_per_epoch == 0:
+            raise ValueError(
+                f"batch={batch} exceeds the rank's {self.num_samples} samples"
+            )
+        self.prefetch_next_epoch = prefetch_next_epoch
+        self._cache: dict[int, jax.Array] = {}
+
+    def _regen(self, epoch: int) -> jax.Array:
+        return epoch_indices_jax(
+            self.n, self.window, self.seed, epoch, self.rank, self.world,
+            **self.kwargs,
+        )
+
+    def epoch_array(self, epoch: int) -> jax.Array:
+        arr = self._cache.pop(epoch, None)
+        if arr is None:
+            arr = self._regen(epoch)
+        return arr
+
+    def epoch(self, epoch: int) -> Iterator[jax.Array]:
+        idx = self.epoch_array(epoch)
+        if self.prefetch_next_epoch:
+            # async dispatch — device works on it behind this epoch's steps
+            self._cache[epoch + 1] = self._regen(epoch + 1)
+            if len(self._cache) > 2:  # bound memory if epochs are skipped
+                for k in sorted(self._cache)[:-2]:
+                    del self._cache[k]
+        for s in range(self.steps_per_epoch):
+            start = s * self.batch
+            size = min(self.batch, self.num_samples - start)
+            if size == self.batch:
+                yield jax.lax.dynamic_slice(idx, (start,), (self.batch,))
+            else:
+                yield idx[start:start + size]

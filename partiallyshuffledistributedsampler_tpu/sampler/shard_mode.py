@@ -1,0 +1,68 @@
+"""Shard-index mode: partial shuffle over *storage shards* (WebDataset/tar,
+tokenized C4 shard files — the [B] configs 3-4).
+
+At billion-sample scale the shuffle unit is often the shard file, not the
+sample: shard order is permuted globally (windowed, for locality across a
+storage prefix), samples inside a shard stream sequentially or through a
+small in-memory shuffle buffer.  That is exactly the core law with
+``n = num_shards`` (SURVEY.md §7 build order #7), so this module is a thin
+vocabulary layer over the same spec — no second shuffle implementation.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional, Sequence
+
+import numpy as np
+
+from ..ops import core
+from ..ops.cpu import epoch_indices_np
+from .torch_shim import PartiallyShuffleDistributedSampler
+
+
+class PartialShuffleShardSampler(PartiallyShuffleDistributedSampler):
+    """Yields shard ids for this rank, windowed-shuffled per epoch.
+
+    Identical contract to the sample-level sampler; the ``window`` now
+    bounds how far a shard moves from its stored order — keeping reads
+    clustered within a storage prefix while still decorrelating epochs.
+    """
+
+    def __init__(self, num_shards: int, **kwargs) -> None:
+        kwargs.setdefault("window", 64)
+        super().__init__(int(num_shards), **kwargs)
+
+
+def expand_shard_indices(
+    shard_ids: Sequence[int],
+    shard_sizes: Sequence[int],
+    *,
+    seed: int = 0,
+    epoch: int = 0,
+    within_shard_shuffle: bool = True,
+    rounds: int = core.DEFAULT_ROUNDS,
+) -> Iterator[int]:
+    """Expand a rank's shard-id stream into global sample indices.
+
+    ``shard_sizes[i]`` is the sample count of shard ``i``; sample index
+    space is the concatenation of shards in id order.  Within a shard the
+    samples are emitted in keyed-bijection order (window = whole shard) or
+    sequentially — deterministic in (seed, epoch, shard), so resume can
+    replay exactly.
+    """
+    sizes = np.asarray(shard_sizes, dtype=np.int64)
+    offsets = np.concatenate([[0], np.cumsum(sizes)[:-1]])
+    for sid in shard_ids:
+        m = int(sizes[sid])
+        if m == 0:
+            continue
+        if within_shard_shuffle and m > 1:
+            order = epoch_indices_np(
+                m, m, seed ^ (0x9E3779B97F4A7C15 + sid), epoch, 0, 1,
+                rounds=rounds,
+            )
+        else:
+            order = range(m)
+        base = int(offsets[sid])
+        for o in order:
+            yield base + int(o)

@@ -1,0 +1,202 @@
+"""Drop-in ``torch.utils.data.Sampler``: the reference's public surface.
+
+Keeps the contract intact per BASELINE.json [B] — ``__init__`` (superset of
+the base ``DistributedSampler`` signature, ``torch/utils/data/distributed.py:
+66-74`` [T]), ``__iter__``, ``__len__``, ``set_epoch`` — so existing DDP
+DataLoader pipelines run unchanged; ``backend='xla'`` swaps the host-side
+index generation for the on-device JAX path (each rank's index tensor is
+produced in HBM and streamed back once per epoch).
+
+Beyond the reference surface:
+
+* ``state_dict()`` / ``load_state_dict()`` — mid-epoch checkpoint/resume in
+  the torchdata ``StatefulDataLoader`` convention.  State is just
+  ``(seed, epoch, offset)`` because the permutation is stateless and
+  random-access (SURVEY.md §5 "Checkpoint/resume").
+* epoch *prefetch*: on the xla backend ``set_epoch`` dispatches the regen
+  asynchronously, so the device computes next epoch's indices while the host
+  finishes the current one; ``__iter__`` only blocks on the final transfer.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Iterator, Optional, Union
+
+import numpy as np
+
+try:
+    from torch.utils.data import Sampler as _TorchSampler
+
+    _HAVE_TORCH = True
+except Exception:  # torch is an optional dependency of this framework
+    _TorchSampler = object
+    _HAVE_TORCH = False
+
+from ..ops import core
+from ..ops.cpu import epoch_indices_np
+
+SPEC_VERSION = 1
+
+
+def _resolve_identity(num_replicas: Optional[int], rank: Optional[int]):
+    """Mirror of the base-class identity discovery (distributed.py:75-86 [T]):
+    fall back to torch.distributed only when args are omitted."""
+    if num_replicas is not None and rank is not None:
+        return int(num_replicas), int(rank)
+    if not _HAVE_TORCH:
+        raise RuntimeError(
+            "num_replicas/rank not given and torch is unavailable; pass them "
+            "explicitly"
+        )
+    import torch.distributed as dist
+
+    if not dist.is_available() or not dist.is_initialized():
+        raise RuntimeError(
+            "num_replicas/rank not given and torch.distributed is not "
+            "initialized; pass them explicitly (the multi-rank-without-a-"
+            "cluster testing trick depends on explicit args, SURVEY.md §4)"
+        )
+    world = dist.get_world_size() if num_replicas is None else int(num_replicas)
+    r = dist.get_rank() if rank is None else int(rank)
+    return world, r
+
+
+class PartiallyShuffleDistributedSampler(_TorchSampler):
+    """Partial-shuffle distributed sampler with an on-device XLA backend.
+
+    Parameters follow ``DistributedSampler`` (dataset, num_replicas, rank,
+    shuffle, seed, drop_last) plus the partial-shuffle controls:
+
+    window:        shuffle locality radius W (SPEC.md §3); indices move only
+                   within W-sized windows (plus window-order permutation).
+    order_windows: also permute the order of full windows (default True).
+    partition:     'strided' (torch law) or 'blocked' (contiguous shards).
+    backend:       'cpu' (numpy reference), 'xla' (on-device JAX), or 'auto'
+                   (xla when jax imports, else cpu).
+    rounds:        swap-or-not round count (SPEC.md §2); default 24.
+
+    ``dataset`` may be any ``Sized`` or a plain ``int`` length — handy for
+    shard-index mode where there is no Dataset object (WebDataset config [B]).
+    """
+
+    def __init__(
+        self,
+        dataset: Union[int, "object"],
+        num_replicas: Optional[int] = None,
+        rank: Optional[int] = None,
+        shuffle: bool = True,
+        seed: int = 0,
+        drop_last: bool = False,
+        *,
+        window: int = core.DEFAULT_WINDOW,
+        order_windows: bool = True,
+        partition: str = "strided",
+        backend: str = "auto",
+        rounds: int = core.DEFAULT_ROUNDS,
+    ) -> None:
+        self.n = int(dataset) if isinstance(dataset, int) else len(dataset)
+        self.num_replicas, self.rank = _resolve_identity(num_replicas, rank)
+        if not (0 <= self.rank < self.num_replicas):
+            raise ValueError(
+                f"rank must be in [0, {self.num_replicas}), got {self.rank}"
+            )
+        self.shuffle = bool(shuffle)
+        self.seed = int(seed)
+        self.drop_last = bool(drop_last)
+        self.window = int(window)
+        self.order_windows = bool(order_windows)
+        self.partition = partition
+        self.rounds = int(rounds)
+        self.num_samples, self.total_size = core.shard_sizes(
+            self.n, self.num_replicas, self.drop_last
+        )
+        self.epoch = 0
+        self._offset = 0  # resume offset within the current epoch
+        if backend == "auto":
+            try:
+                import jax  # noqa: F401
+
+                backend = "xla"
+            except Exception:
+                backend = "cpu"
+        if backend not in ("cpu", "xla"):
+            raise ValueError(f"backend must be 'cpu', 'xla' or 'auto', got {backend!r}")
+        self.backend = backend
+        self._pending_epoch: Optional[int] = None
+        self._pending = None  # in-flight device array for _pending_epoch
+
+    # ------------------------------------------------------------- generation
+    def _generate_device(self, epoch: int):
+        from ..ops.xla import epoch_indices_jax
+
+        return epoch_indices_jax(
+            self.n, self.window, self.seed, epoch, self.rank,
+            self.num_replicas, shuffle=self.shuffle, drop_last=self.drop_last,
+            order_windows=self.order_windows, partition=self.partition,
+            rounds=self.rounds,
+        )
+
+    def epoch_indices(self, epoch: Optional[int] = None) -> np.ndarray:
+        """This rank's full index order for ``epoch`` (default: current)."""
+        e = self.epoch if epoch is None else int(epoch)
+        if self.backend == "xla":
+            if self._pending_epoch == e and self._pending is not None:
+                arr = np.asarray(self._pending)
+                self._pending = None
+                self._pending_epoch = None
+                return arr
+            return np.asarray(self._generate_device(e))
+        return epoch_indices_np(
+            self.n, self.window, self.seed, e, self.rank, self.num_replicas,
+            shuffle=self.shuffle, drop_last=self.drop_last,
+            order_windows=self.order_windows, partition=self.partition,
+            rounds=self.rounds,
+        )
+
+    # ---------------------------------------------------------- Sampler API
+    def __iter__(self) -> Iterator[int]:
+        indices = self.epoch_indices()
+        start = self._offset
+        self._offset = 0  # a fresh epoch starts at 0 unless state is loaded
+        for i in indices[start:].tolist():
+            yield i
+
+    def __len__(self) -> int:
+        return self.num_samples
+
+    def set_epoch(self, epoch: int) -> None:
+        """Set the epoch for deterministic reshuffling (distributed.py:146-157
+        [T]).  On the xla backend this *dispatches* the on-device regen
+        immediately (async), overlapping it with whatever the host does next."""
+        self.epoch = int(epoch)
+        if self.backend == "xla":
+            self._pending = self._generate_device(self.epoch)
+            self._pending_epoch = self.epoch
+
+    # ------------------------------------------------------ checkpoint/resume
+    def state_dict(self, consumed: int = 0) -> dict:
+        """Snapshot sampler state.  ``consumed`` = samples already drawn this
+        epoch (the training loop knows it as step*batch_size for this rank)."""
+        return {
+            "spec_version": SPEC_VERSION,
+            "seed": self.seed,
+            "epoch": self.epoch,
+            "offset": int(consumed),
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        if state.get("spec_version", SPEC_VERSION) != SPEC_VERSION:
+            raise ValueError(
+                f"checkpoint from spec version {state['spec_version']}, "
+                f"this build implements {SPEC_VERSION}; the permutation law "
+                "differs and silent reshuffling would occur"
+            )
+        self.seed = int(state["seed"])
+        self.epoch = int(state["epoch"])
+        offset = int(state.get("offset", 0))
+        if not (0 <= offset <= self.num_samples):
+            raise ValueError(
+                f"offset {offset} outside [0, {self.num_samples}]"
+            )
+        self._offset = offset

@@ -1,0 +1,4 @@
+"""Observability utilities: stall probe and regen-latency metrics."""
+
+from .stall_probe import StallProbe  # noqa: F401
+from .metrics import RegenTimer  # noqa: F401

@@ -1,0 +1,51 @@
+"""Regen-latency counters (the first driver metric: per-epoch index-gen ms).
+
+Lightweight, dependency-free; samplers and the bench harness share it so the
+number reported by ``bench.py`` and the number a training loop observes are
+produced the same way.
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+
+
+class RegenTimer:
+    """Accumulates per-epoch regen latencies.
+
+        timer = RegenTimer()
+        with timer.measure():
+            idx = epoch_indices_jax(...); idx.block_until_ready()
+        timer.last_ms, timer.mean_ms, timer.count
+    """
+
+    def __init__(self) -> None:
+        self.samples_ms: list[float] = []
+
+    @contextmanager
+    def measure(self):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.samples_ms.append((time.perf_counter() - t0) * 1e3)
+
+    @property
+    def count(self) -> int:
+        return len(self.samples_ms)
+
+    @property
+    def last_ms(self) -> float:
+        return self.samples_ms[-1] if self.samples_ms else 0.0
+
+    @property
+    def mean_ms(self) -> float:
+        return sum(self.samples_ms) / len(self.samples_ms) if self.samples_ms else 0.0
+
+    def report(self) -> dict:
+        return {
+            "epochs_timed": self.count,
+            "last_ms": round(self.last_ms, 3),
+            "mean_ms": round(self.mean_ms, 3),
+        }

@@ -17,4 +17,15 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# Pytest plugins may import jax before this file runs, freezing the config
+# defaults from the *original* env — override the live config too.  This must
+# happen before the first backend use (device queries in fixtures), which it
+# does because conftest precedes all test imports.
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except ImportError:
+    pass
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,18 @@
+"""Driver entry points must stay importable and runnable."""
+
+import jax
+import jax.numpy as jnp
+
+
+def test_entry_compiles_and_runs():
+    import __graft_entry__ as g
+
+    fn, args = g.entry()
+    out = jax.jit(fn)(*args)
+    assert out.shape[-1] == 512 and jnp.isfinite(out).all()
+
+
+def test_dryrun_multichip_8():
+    import __graft_entry__ as g
+
+    g.dryrun_multichip(8)  # conftest provides the 8-device CPU platform

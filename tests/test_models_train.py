@@ -1,0 +1,72 @@
+"""End-to-end sharded training on the virtual 8-device CPU mesh: the
+minimum slice of SURVEY.md §7 build order #3/#4 at test scale."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from partiallyshuffledistributedsampler_tpu.models import (
+    GPTConfig,
+    demo_training_run,
+    forward,
+    init_params,
+    make_mesh,
+)
+
+TINY = GPTConfig(vocab_size=64, seq_len=16, d_model=32, n_layers=1,
+                 n_heads=2, d_ff=64)
+
+
+def test_forward_shapes():
+    params = init_params(TINY, jax.random.PRNGKey(0))
+    tokens = jnp.zeros((2, TINY.seq_len), jnp.int32)
+    logits = forward(TINY, params, tokens)
+    assert logits.shape == (2, TINY.seq_len, TINY.vocab_size)
+    assert logits.dtype == jnp.float32
+
+
+def test_mesh_factorization():
+    m = make_mesh(8)
+    assert dict(m.shape) == {"dp": 4, "tp": 2}
+    m1 = make_mesh(1)
+    assert dict(m1.shape) == {"dp": 1, "tp": 1}
+
+
+def test_params_actually_sharded_over_tp():
+    from partiallyshuffledistributedsampler_tpu.models.train import (
+        create_sharded_state,
+    )
+
+    mesh = make_mesh(8)
+    params, opt_state, _ = create_sharded_state(TINY, mesh)
+    qkv = params["block0"]["qkv"]["kernel"]
+    assert "tp" in str(qkv.sharding.spec)  # column-parallel over tp
+    # a device's local shard really holds half the output features
+    local = qkv.addressable_shards[0].data
+    assert local.shape == (qkv.shape[0], qkv.shape[1] // 2)
+    # optimizer state inherited the same sharding leaf-for-leaf
+    mu_qkv = opt_state[0].mu["block0"]["qkv"]["kernel"]
+    assert mu_qkv.sharding == qkv.sharding
+
+
+def test_training_runs_and_losses_finite():
+    mesh = make_mesh(8)
+    losses = demo_training_run(
+        mesh, TINY, n_samples=64, window=16, batch_per_dp=2,
+        steps_per_epoch=2, epochs=2,
+    )
+    assert len(losses) == 4
+    assert all(np.isfinite(l) for l in losses)
+
+
+def test_training_deterministic_across_meshes():
+    # dp=4,tp=2 vs dp=2,tp=2: same data order per epoch (the sampler contract
+    # holds per dp-world); losses differ because dp-world differs — but a
+    # fixed mesh rerun must be bit-reproducible.
+    mesh = make_mesh(8)
+    a = demo_training_run(mesh, TINY, n_samples=64, window=16,
+                          batch_per_dp=2, steps_per_epoch=2, epochs=1)
+    b = demo_training_run(mesh, TINY, n_samples=64, window=16,
+                          batch_per_dp=2, steps_per_epoch=2, epochs=1)
+    assert a == b

@@ -1,0 +1,101 @@
+"""Multi-device regen + ICI seed agreement on the virtual 8-device CPU mesh
+(SURVEY.md §4 invariant 8: testable without a pod via
+xla_force_host_platform_device_count; conftest.py sets it)."""
+
+import jax
+import numpy as np
+import pytest
+
+from partiallyshuffledistributedsampler_tpu.ops import cpu
+from partiallyshuffledistributedsampler_tpu.parallel import (
+    data_mesh,
+    sharded_epoch_indices,
+)
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    assert len(jax.devices()) >= 8, "conftest must force 8 CPU devices"
+    return data_mesh(8)
+
+
+def test_sharded_matches_cpu_reference(mesh8):
+    n, w, seed, epoch = 10_000, 512, 42, 3
+    out = np.asarray(sharded_epoch_indices(mesh8, n, w, seed, epoch))
+    assert out.shape == (8, 1250)
+    for r in range(8):
+        ref = cpu.epoch_indices_np(n, w, seed, epoch, r, 8)
+        np.testing.assert_array_equal(out[r], ref)
+
+
+def test_output_is_sharded_over_mesh(mesh8):
+    out = sharded_epoch_indices(mesh8, 8000, 128, 0, 0)
+    # each row must live on its own device — indices are generated in place,
+    # never gathered through the host
+    assert len(out.sharding.device_set) == 8
+    shard_rows = sorted(
+        (s.index[0].start or 0) for s in out.addressable_shards
+    )
+    assert shard_rows == list(range(8))
+
+
+def test_seed_agreement_rank0_wins(mesh8):
+    # devices disagree wildly; the ICI collective must impose rank 0's triple
+    n, w = 5000, 64
+    local = np.stack(
+        [
+            np.asarray([123, 0, 7], np.uint32),          # rank 0: the truth
+            *[np.asarray([999 + r, r, 60 + r], np.uint32) for r in range(1, 8)]
+        ]
+    )
+    out = np.asarray(
+        sharded_epoch_indices(mesh8, n, w, None, None, local_seeds=local)
+    )
+    for r in range(8):
+        ref = cpu.epoch_indices_np(n, w, 123, 7, r, 8)
+        np.testing.assert_array_equal(out[r], ref)
+
+
+def test_seed_agreement_is_deterministic_collective(mesh8):
+    a = np.asarray(sharded_epoch_indices(mesh8, 4096, 256, 5, 1))
+    b = np.asarray(sharded_epoch_indices(mesh8, 4096, 256, 5, 1))
+    np.testing.assert_array_equal(a, b)
+
+
+def test_epoch_change_reuses_executable(mesh8):
+    from partiallyshuffledistributedsampler_tpu.parallel import sharded
+
+    sharded_epoch_indices(mesh8, 2048, 64, 1, 0)
+    before = sharded._compiled_sharded.cache_info().misses
+    sharded_epoch_indices(mesh8, 2048, 64, 1, 1)
+    sharded_epoch_indices(mesh8, 2048, 64, 2, 2)
+    assert sharded._compiled_sharded.cache_info().misses == before
+
+
+def test_drop_last_and_blocked(mesh8):
+    out = np.asarray(
+        sharded_epoch_indices(
+            mesh8, 10_001, 100, 9, 2, drop_last=True, partition="blocked"
+        )
+    )
+    assert out.shape == (8, 1250)
+    flat = out.ravel()
+    assert len(np.unique(flat)) == len(flat)  # disjoint under drop_last
+
+
+def test_smaller_mesh_subset():
+    m = data_mesh(4)
+    out = np.asarray(sharded_epoch_indices(m, 1000, 32, 0, 0))
+    assert out.shape == (4, 250)
+    for r in range(4):
+        np.testing.assert_array_equal(
+            out[r], cpu.epoch_indices_np(1000, 32, 0, 0, r, 4)
+        )
+
+
+def test_bad_local_seeds_shape(mesh8):
+    with pytest.raises(ValueError, match="world"):
+        sharded_epoch_indices(
+            mesh8, 100, 10, None, None,
+            local_seeds=np.zeros((4, 3), np.uint32),
+        )

@@ -1,0 +1,129 @@
+"""Stall probe, regen timer, device-native iterator, shard mode."""
+
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from partiallyshuffledistributedsampler_tpu.ops import cpu
+from partiallyshuffledistributedsampler_tpu.sampler import (
+    DeviceEpochIterator,
+    PartialShuffleShardSampler,
+    batch_index_window,
+    expand_shard_indices,
+)
+from partiallyshuffledistributedsampler_tpu.utils import RegenTimer, StallProbe
+
+
+# ------------------------------------------------------------- stall probe
+def _ticks(n, produce_s=0.0):
+    for i in range(n):
+        if produce_s:
+            time.sleep(produce_s)
+        yield i
+
+
+def test_stall_probe_fast_producer():
+    probe = StallProbe(_ticks(20))
+    for _ in probe:
+        time.sleep(0.002)  # consumer compute dominates
+    assert probe.batches == 20
+    assert probe.stall_fraction < 0.5
+    assert probe.report()["stall_pct"] < 50
+
+
+def test_stall_probe_slow_producer():
+    probe = StallProbe(_ticks(10, produce_s=0.004))
+    for _ in probe:
+        pass  # consumer instant -> all time is stall
+    assert probe.stall_fraction > 0.8
+
+
+def test_stall_probe_reset():
+    probe = StallProbe(_ticks(3))
+    list(probe)
+    probe.reset()
+    assert probe.batches == 0 and probe.stall_fraction == 0.0
+
+
+def test_regen_timer():
+    t = RegenTimer()
+    with t.measure():
+        time.sleep(0.001)
+    with t.measure():
+        time.sleep(0.001)
+    assert t.count == 2 and t.last_ms >= 1.0 and t.mean_ms >= 1.0
+    assert t.report()["epochs_timed"] == 2
+
+
+# ------------------------------------------------------ device epoch iterator
+def test_device_iterator_covers_epoch():
+    it = DeviceEpochIterator(n=1000, window=64, batch=100, seed=3, rank=1, world=2)
+    batches = list(it.epoch(0))
+    assert len(batches) == 5  # 500 samples / 100
+    flat = np.concatenate([np.asarray(b) for b in batches])
+    ref = cpu.epoch_indices_np(1000, 64, 3, 0, 1, 2)
+    np.testing.assert_array_equal(flat, ref)
+
+
+def test_device_iterator_prefetch_cache():
+    it = DeviceEpochIterator(n=256, window=16, batch=64, world=1)
+    list(it.epoch(0))
+    assert 1 in it._cache  # epoch 1 prefetched during epoch 0
+    list(it.epoch(1))      # consumes the cache
+    assert 1 not in it._cache
+
+
+def test_device_iterator_partial_final_batch():
+    it = DeviceEpochIterator(
+        n=250, window=32, batch=64, world=1, drop_last_batch=False
+    )
+    sizes = [len(b) for b in it.epoch(0)]
+    assert sizes == [64, 64, 64, 58]
+
+
+def test_device_iterator_batch_too_big():
+    with pytest.raises(ValueError, match="exceeds"):
+        DeviceEpochIterator(n=10, window=4, batch=64, world=2)
+
+
+def test_batch_index_window_1d_and_2d():
+    idx1 = jnp.arange(100, dtype=jnp.int32)
+    w = batch_index_window(idx1, 2, 10)
+    np.testing.assert_array_equal(np.asarray(w), np.arange(20, 30))
+    idx2 = jnp.stack([jnp.arange(50), jnp.arange(50, 100)]).astype(jnp.int32)
+    w2 = batch_index_window(idx2, 1, 5)
+    assert w2.shape == (2, 5)
+    np.testing.assert_array_equal(np.asarray(w2)[0], np.arange(5, 10))
+
+
+# ------------------------------------------------------------- shard mode
+def test_shard_sampler_is_sampler():
+    s = PartialShuffleShardSampler(128, num_replicas=4, rank=0, backend="cpu")
+    s.set_epoch(2)
+    ids = list(s)
+    assert len(ids) == 32 and all(0 <= i < 128 for i in ids)
+
+
+def test_expand_shard_indices_covers():
+    sizes = [5, 0, 3, 7]
+    out = list(
+        expand_shard_indices([0, 2, 3], sizes, seed=1, epoch=0)
+    )
+    # shards 0,2,3: global ranges [0,5), [5,8), [8,15)
+    assert sorted(out) == list(range(0, 5)) + list(range(5, 8)) + list(range(8, 15))
+
+
+def test_expand_shard_indices_sequential_mode():
+    out = list(
+        expand_shard_indices([1], [4, 4], within_shard_shuffle=False)
+    )
+    assert out == [4, 5, 6, 7]
+
+
+def test_expand_deterministic_per_epoch():
+    a = list(expand_shard_indices([0, 1], [8, 8], seed=2, epoch=5))
+    b = list(expand_shard_indices([0, 1], [8, 8], seed=2, epoch=5))
+    c = list(expand_shard_indices([0, 1], [8, 8], seed=2, epoch=6))
+    assert a == b and a != c
