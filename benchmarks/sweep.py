@@ -1,0 +1,86 @@
+"""Regen-latency sweep: every backend across dataset scales (SURVEY.md §6).
+
+Writes JSON lines to stdout — one per (backend, n) — so results can be
+appended next to the BASELINE.md table.  Run on the default device:
+
+    python benchmarks/sweep.py [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+WINDOW = 8192
+WORLD = 256
+REPS = 8
+
+
+def _steady_ms(fn) -> float:
+    fn(0)
+    times = []
+    for e in range(1, REPS + 1):
+        t0 = time.perf_counter()
+        fn(e)
+        times.append((time.perf_counter() - t0) * 1e3)
+    times.sort()
+    return times[len(times) // 4]
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true", help="skip n=1e9 host runs")
+    args = ap.parse_args()
+
+    from partiallyshuffledistributedsampler_tpu.ops import cpu, native
+    from partiallyshuffledistributedsampler_tpu.ops.pallas_kernel import (
+        epoch_indices_pallas,
+    )
+    from partiallyshuffledistributedsampler_tpu.ops.xla import epoch_indices_jax
+
+    try:
+        native.build()
+        have_native = True
+    except Exception:
+        have_native = False
+
+    scales = [10**6, 10**7, 10**8, 10**9]
+    for n in scales:
+        w = min(WINDOW, n)
+        backends = {
+            "xla": lambda e, n=n, w=w: epoch_indices_jax(
+                n, w, 0, e, 0, WORLD
+            ).block_until_ready(),
+            "pallas": lambda e, n=n, w=w: epoch_indices_pallas(
+                n, w, 0, e, 0, WORLD
+            ).block_until_ready(),
+        }
+        host_ok = args.quick is False or n <= 10**8
+        if host_ok:
+            backends["numpy"] = lambda e, n=n, w=w: cpu.epoch_indices_np(
+                n, w, 0, e, 0, WORLD
+            )
+            if have_native:
+                backends["native"] = lambda e, n=n, w=w: native.epoch_indices_native(
+                    n, w, 0, e, 0, WORLD
+                )
+        for name, fn in backends.items():
+            try:
+                ms = _steady_ms(fn)
+                print(json.dumps({
+                    "backend": name, "n": n, "window": w, "world": WORLD,
+                    "per_epoch_ms": round(ms, 3),
+                }), flush=True)
+            except Exception as exc:
+                print(json.dumps({
+                    "backend": name, "n": n, "error": repr(exc)[:150]
+                }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,176 @@
+// Native host implementation of the SPEC.md permutation law.
+//
+// Plays the role the reference delegates to torch's C++ randperm kernel
+// (BASELINE.json: "host-side torch.randperm"; SURVEY.md §2 native-components
+// note): the fast host path behind backend='cpu' when the extension is
+// built.  Must stay bit-identical to ops/core.py — the shared law is frozen
+// in SPEC.md and cross-checked by tests/test_native.py against the numpy
+// reference.
+//
+// Build: `make -C csrc` (plain g++ -O3; no external deps).  Loaded via
+// ctypes by ops/native.py; absence is never an error (numpy fallback).
+
+#include <cstdint>
+
+namespace {
+
+constexpr uint32_t GOLDEN = 0x9E3779B9u;
+constexpr uint32_t RC_BIT = 0x7FEB352Du;
+constexpr uint32_t C_SEED_HI = 0x85EBCA6Bu;
+constexpr uint32_t C_EPOCH = 0xC2B2AE35u;
+constexpr uint32_t C_OUTER = 0xA5A5A5A5u;
+constexpr uint32_t C_INNER = 0x5A5A5A5Au;
+constexpr uint32_t C_TAIL = 0x3C3C3C3Cu;
+constexpr uint32_t C_WIN = 0x27D4EB2Fu;
+constexpr uint32_t C_BIT = 0x94D049BBu;
+constexpr uint32_t C_PAIR = 0x165667B1u;
+
+inline uint32_t mix32(uint32_t x) {
+  x ^= x >> 16;
+  x *= 0x85EBCA6Bu;
+  x ^= x >> 13;
+  x *= 0xC2B2AE35u;
+  x ^= x >> 16;
+  return x;
+}
+
+// SPEC.md §2: swap-or-not with scalar pairing key.  Round keys K_r depend
+// only on (pair_key, r, m) — the caller precomputes them once per domain.
+struct SonSchedule {
+  uint32_t k[64];      // K_r per round (rounds <= 64 enforced by wrapper)
+  uint32_t rc_bit[64]; // r * RC_BIT
+  uint32_t rounds;
+  uint32_t m;
+};
+
+inline void make_schedule(SonSchedule &s, uint32_t m, uint32_t pair_key,
+                          uint32_t rounds) {
+  s.m = m;
+  s.rounds = rounds;
+  for (uint32_t r = 0; r < rounds; ++r) {
+    s.k[r] = mix32(pair_key ^ (uint32_t)(r * GOLDEN)) % m;
+    s.rc_bit[r] = (uint32_t)(r * RC_BIT);
+  }
+}
+
+inline uint32_t son_apply(const SonSchedule &s, uint32_t x, uint32_t key2) {
+  const uint32_t m = s.m;
+  for (uint32_t r = 0; r < s.rounds; ++r) {
+    uint32_t partner = s.k[r] + (m - x);
+    if (partner >= m) partner -= m;
+    uint32_t c = x > partner ? x : partner;
+    uint32_t b = mix32(c ^ key2 ^ s.rc_bit[r]);
+    if (b & 1u) x = partner;
+  }
+  return x;
+}
+
+// one-shot variant for the outer/tail bijections (scalar key == pair key)
+inline uint32_t son(uint32_t x, uint32_t m, uint32_t key, uint32_t rounds) {
+  if (m <= 1) return x;
+  SonSchedule s;
+  make_schedule(s, m, key, rounds);
+  return son_apply(s, x, mix32(key ^ C_BIT));
+}
+
+inline uint32_t derive_epoch_key(uint32_t seed_lo, uint32_t seed_hi,
+                                 uint32_t epoch) {
+  uint32_t k = mix32(seed_lo ^ GOLDEN);
+  k = mix32(k ^ mix32(seed_hi ^ C_SEED_HI));
+  k = mix32(k ^ mix32(epoch ^ C_EPOCH));
+  return k;
+}
+
+template <typename OutT>
+int epoch_indices_impl(uint64_t n, uint32_t window, uint32_t seed_lo,
+                       uint32_t seed_hi, uint32_t epoch, uint64_t rank,
+                       uint64_t world, int shuffle, int order_windows,
+                       int strided, uint32_t rounds, uint64_t num_samples,
+                       OutT *out) {
+  if (n == 0 || world == 0 || rank >= world || window == 0) return -1;
+  if (rounds > 64) return -2;
+  if (window > 0x7FFFFFFFu) return -3;
+  const uint64_t nw_full = n / window;
+  if (nw_full > 0x7FFFFFFFull) return -3;
+  const uint64_t body_len = nw_full * window;
+  const uint32_t tail_len = (uint32_t)(n - body_len);
+
+  if (!shuffle) {
+    for (uint64_t i = 0; i < num_samples; ++i) {
+      uint64_t p = strided ? rank + world * i : rank * num_samples + i;
+      out[i] = (OutT)(p % n);
+    }
+    return 0;
+  }
+
+  const uint32_t ek = derive_epoch_key(seed_lo, seed_hi, epoch);
+  const uint32_t okey = mix32(ek ^ C_OUTER);
+  const uint32_t tkey = mix32(ek ^ C_TAIL);
+  const uint32_t pair_inner = mix32(ek ^ C_PAIR);
+  const bool do_outer = order_windows && nw_full > 1;
+
+  SonSchedule inner_sched;
+  if (nw_full > 0) make_schedule(inner_sched, window, pair_inner, rounds);
+
+  // cache the last output slot's resolved window: consecutive positions of a
+  // rank usually fall in the same slot (always, for blocked partition)
+  uint64_t cached_j = ~0ull;
+  uint32_t cached_k = 0, cached_key2 = 0;
+
+  for (uint64_t i = 0; i < num_samples; ++i) {
+    uint64_t p = strided ? rank + world * i : rank * num_samples + i;
+    p %= n;
+    uint64_t idx;
+    if (p < body_len) {
+      const uint64_t j = p / window;
+      const uint32_t r0 = (uint32_t)(p % window);
+      if (j != cached_j) {
+        cached_j = j;
+        cached_k = do_outer
+                       ? son((uint32_t)j, (uint32_t)nw_full, okey, rounds)
+                       : (uint32_t)j;
+        const uint32_t kin =
+            mix32(ek ^ C_INNER ^ mix32(cached_k ^ C_WIN));
+        cached_key2 = mix32(kin ^ C_BIT);
+      }
+      idx = (uint64_t)cached_k * window +
+            son_apply(inner_sched, r0, cached_key2);
+    } else {
+      const uint32_t t = (uint32_t)(p - body_len);
+      idx = body_len + son(t, tail_len, tkey, rounds);
+    }
+    out[i] = (OutT)idx;
+  }
+  return 0;
+}
+
+} // namespace
+
+extern "C" {
+
+// Fills out[0..num_samples) with rank's epoch indices.  out_width selects
+// the element type: 4 (int32, requires n <= 2^31-1) or 8 (int64) — writing
+// int32 directly avoids a second pass over the buffer on the host hot path.
+// Returns 0 on success, negative on argument errors.  All domain checks
+// mirror ops/core.py (window < 2^31, n/window < 2^31).
+int psds_epoch_indices(uint64_t n, uint32_t window, uint32_t seed_lo,
+                       uint32_t seed_hi, uint32_t epoch, uint64_t rank,
+                       uint64_t world, int shuffle, int order_windows,
+                       int strided, uint32_t rounds, uint64_t num_samples,
+                       int out_width, void *out) {
+  if (out_width == 4) {
+    if (n > 0x7FFFFFFFull) return -4;
+    return epoch_indices_impl<int32_t>(n, window, seed_lo, seed_hi, epoch,
+                                       rank, world, shuffle, order_windows,
+                                       strided, rounds, num_samples,
+                                       (int32_t *)out);
+  }
+  if (out_width == 8)
+    return epoch_indices_impl<int64_t>(n, window, seed_lo, seed_hi, epoch,
+                                       rank, world, shuffle, order_windows,
+                                       strided, rounds, num_samples,
+                                       (int64_t *)out);
+  return -5;
+}
+
+} // extern "C"

@@ -1,0 +1,48 @@
+"""JAX-native usage: mesh-sharded sampler feeding a sharded training step —
+indices are generated and consumed entirely in HBM (driver config #3 shape:
+token shards + GPT, scaled down to run anywhere).
+
+Run: python examples/jax_training_example.py
+(Uses the virtual CPU mesh if fewer than 2 real devices are present.)
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    # Demo default: an 8-device virtual CPU mesh, set up BEFORE the first
+    # backend query (flags are ignored once XLA initializes).  Export
+    # PSDS_EXAMPLE_REAL=1 to use whatever real devices are present instead.
+    use_real = os.environ.get("PSDS_EXAMPLE_REAL") == "1"
+    if not use_real:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    import jax
+
+    if not use_real:
+        jax.config.update("jax_platforms", "cpu")
+
+    from partiallyshuffledistributedsampler_tpu.models import (
+        GPTConfig, demo_training_run, make_mesh,
+    )
+
+    mesh = make_mesh()
+    print(f"mesh: {dict(mesh.shape)} over {mesh.devices.size} devices")
+    losses = demo_training_run(
+        mesh,
+        GPTConfig(),
+        n_samples=2048, window=256, batch_per_dp=8,
+        steps_per_epoch=4, epochs=3,
+    )
+    print("losses:", [round(l, 3) for l in losses])
+    assert losses[-1] < losses[0], "loss should decrease on synthetic data"
+    print("ok: sharded sampler -> sharded train step, indices never left HBM")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,107 @@
+"""ctypes loader for the native C++ host path (csrc/psds_core.cpp).
+
+The extension is optional: ``epoch_indices_native`` raises ``RuntimeError``
+when the .so is absent and callers (the torch shim's cpu backend) fall back
+to numpy.  ``build()`` compiles it on demand with the repo Makefile (plain
+g++, no pybind11 — ctypes over a C ABI per the environment constraints).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+from . import core
+
+_CSRC = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "csrc",
+)
+_SO = os.path.join(_CSRC, "libpsds_core.so")
+_lib: Optional[ctypes.CDLL] = None
+
+
+def build(force: bool = False) -> str:
+    """Compile the extension (make handles staleness, so edits to
+    psds_core.cpp always rebuild).  Returns the .so path."""
+    global _lib
+    cmd = ["make", "-C", _CSRC] + (["-B"] if force else [])
+    res = subprocess.run(cmd, capture_output=True, text=True)
+    if res.returncode != 0:
+        raise RuntimeError(
+            f"native build failed (exit {res.returncode}):\n{res.stderr[-2000:]}"
+        )
+    if "up to date" not in res.stdout:
+        _lib = None  # freshly built: drop any previously loaded handle
+    return _SO
+
+
+def _load() -> ctypes.CDLL:
+    global _lib
+    if _lib is None:
+        if not os.path.exists(_SO):
+            raise RuntimeError(
+                f"native extension not built ({_SO} missing); run "
+                "ops.native.build() or `make -C csrc`"
+            )
+        lib = ctypes.CDLL(_SO)
+        lib.psds_epoch_indices.restype = ctypes.c_int
+        lib.psds_epoch_indices.argtypes = [
+            ctypes.c_uint64, ctypes.c_uint32, ctypes.c_uint32,
+            ctypes.c_uint32, ctypes.c_uint32, ctypes.c_uint64,
+            ctypes.c_uint64, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_uint32, ctypes.c_uint64, ctypes.c_int,
+            ctypes.c_void_p,
+        ]
+        _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    try:
+        _load()
+        return True
+    except Exception:  # missing, corrupt, or wrong-arch .so: fall back
+        return False
+
+
+def epoch_indices_native(
+    n: int,
+    window: int,
+    seed: int,
+    epoch: int,
+    rank: int,
+    world: int,
+    *,
+    shuffle: bool = True,
+    drop_last: bool = False,
+    order_windows: bool = True,
+    partition: str = "strided",
+    rounds: int = core.DEFAULT_ROUNDS,
+) -> np.ndarray:
+    """Bit-identical to ``epoch_indices_np`` via the C++ kernel."""
+    if not (0 <= rank < world):
+        raise ValueError(f"rank must be in [0, {world}), got {rank}")
+    if partition not in ("strided", "blocked"):
+        raise ValueError(f"partition must be 'strided' or 'blocked', got {partition!r}")
+    if rounds > 64:
+        raise ValueError("native path supports rounds <= 64")
+    lib = _load()
+    num_samples, _ = core.shard_sizes(n, world, drop_last)
+    # write the final dtype directly — no post-pass over the buffer
+    dtype = np.int32 if n <= 0x7FFFFFFF else np.int64
+    out = np.empty(num_samples, dtype=dtype)
+    lo, hi = core.fold_seed(int(seed))
+    rc = lib.psds_epoch_indices(
+        n, window, lo, hi, int(epoch) & 0xFFFFFFFF, rank, world,
+        int(bool(shuffle)), int(bool(order_windows)),
+        int(partition == "strided"), rounds, num_samples,
+        out.itemsize, out.ctypes.data_as(ctypes.c_void_p),
+    )
+    if rc != 0:
+        raise ValueError(f"psds_epoch_indices failed with code {rc}")
+    return out

@@ -72,8 +72,9 @@ class PartiallyShuffleDistributedSampler(_TorchSampler):
                    within W-sized windows (plus window-order permutation).
     order_windows: also permute the order of full windows (default True).
     partition:     'strided' (torch law) or 'blocked' (contiguous shards).
-    backend:       'cpu' (numpy reference), 'xla' (on-device JAX), or 'auto'
-                   (xla when jax imports, else cpu).
+    backend:       'cpu' (numpy reference), 'native' (C++ host kernel,
+                   csrc/), 'xla' (on-device JAX), or 'auto' (xla when jax
+                   imports, else native when built, else cpu).
     rounds:        swap-or-not round count (SPEC.md §2); default 24.
 
     ``dataset`` may be any ``Sized`` or a plain ``int`` length — handy for
@@ -119,12 +120,23 @@ class PartiallyShuffleDistributedSampler(_TorchSampler):
 
                 backend = "xla"
             except Exception:
-                backend = "cpu"
-        if backend not in ("cpu", "xla"):
-            raise ValueError(f"backend must be 'cpu', 'xla' or 'auto', got {backend!r}")
+                from ..ops import native as _native
+
+                backend = "native" if _native.available() else "cpu"
+        if backend not in ("cpu", "native", "xla"):
+            raise ValueError(
+                f"backend must be 'cpu', 'native', 'xla' or 'auto', got {backend!r}"
+            )
+        if backend == "native":
+            from ..ops import native as _native
+
+            _native.build()  # raises early if the toolchain is missing
         self.backend = backend
         self._pending_epoch: Optional[int] = None
         self._pending = None  # in-flight device array for _pending_epoch
+        from ..utils.metrics import RegenTimer
+
+        self.regen_timer = RegenTimer()  # per-epoch index-gen ms (driver metric)
 
     # ------------------------------------------------------------- generation
     def _generate_device(self, epoch: int):
@@ -139,6 +151,10 @@ class PartiallyShuffleDistributedSampler(_TorchSampler):
 
     def epoch_indices(self, epoch: Optional[int] = None) -> np.ndarray:
         """This rank's full index order for ``epoch`` (default: current)."""
+        with self.regen_timer.measure():
+            return self._epoch_indices(epoch)
+
+    def _epoch_indices(self, epoch: Optional[int]) -> np.ndarray:
         e = self.epoch if epoch is None else int(epoch)
         if self.backend == "xla":
             if self._pending_epoch == e and self._pending is not None:
@@ -147,6 +163,15 @@ class PartiallyShuffleDistributedSampler(_TorchSampler):
                 self._pending_epoch = None
                 return arr
             return np.asarray(self._generate_device(e))
+        if self.backend == "native":
+            from ..ops.native import epoch_indices_native
+
+            return epoch_indices_native(
+                self.n, self.window, self.seed, e, self.rank,
+                self.num_replicas, shuffle=self.shuffle,
+                drop_last=self.drop_last, order_windows=self.order_windows,
+                partition=self.partition, rounds=self.rounds,
+            )
         return epoch_indices_np(
             self.n, self.window, self.seed, e, self.rank, self.num_replicas,
             shuffle=self.shuffle, drop_last=self.drop_last,
@@ -173,6 +198,12 @@ class PartiallyShuffleDistributedSampler(_TorchSampler):
         if self.backend == "xla":
             self._pending = self._generate_device(self.epoch)
             self._pending_epoch = self.epoch
+            try:
+                # start the device->host copy now too, so __iter__'s
+                # np.asarray finds the bytes already on the host
+                self._pending.copy_to_host_async()
+            except AttributeError:
+                pass
 
     # ------------------------------------------------------ checkpoint/resume
     def state_dict(self, consumed: int = 0) -> dict:

@@ -1,0 +1,84 @@
+"""Big index spaces (n >= 2^31): the 10B-sample Llama-pretrain config [B].
+
+x64 must be enabled process-wide before jit, so the jax-side parity check
+runs in a subprocess; the numpy reference path needs no flag (it always uses
+uint64 positions).
+"""
+
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from partiallyshuffledistributedsampler_tpu.ops import core, cpu
+
+TEN_B = 10_000_000_000
+
+
+def test_numpy_path_int64():
+    # world chosen so the shard is small; indices exceed 2^31
+    idx = cpu.epoch_indices_np(TEN_B, 8192, 7, 2, 3, 2_000_000)
+    assert idx.dtype == np.int64
+    assert len(idx) == 5000
+    assert idx.max() > 2**31  # actually reaches the high index space
+    assert (idx >= 0).all() and (idx < TEN_B).all()
+
+
+def test_numpy_int64_determinism_and_epochs():
+    a = cpu.epoch_indices_np(TEN_B, 8192, 7, 2, 0, 2_000_000)
+    b = cpu.epoch_indices_np(TEN_B, 8192, 7, 2, 0, 2_000_000)
+    c = cpu.epoch_indices_np(TEN_B, 8192, 7, 3, 0, 2_000_000)
+    np.testing.assert_array_equal(a, b)
+    assert (a != c).mean() > 0.5
+
+
+def test_numpy_int64_partition_small():
+    # exhaustive partition check just over the 2^31 boundary
+    n = 2**31 + 11
+    world = 1 << 20
+    shards = [
+        cpu.epoch_indices_np(n, 4096, 0, 0, r, world)
+        for r in (0, 1, world - 1)
+    ]
+    for s in shards:
+        assert s.dtype == np.int64 and (s < n).all() and (s >= 0).all()
+    num_samples, _ = core.shard_sizes(n, world, False)
+    assert all(len(s) == num_samples for s in shards)
+
+
+def test_jax_x64_parity_subprocess():
+    code = textwrap.dedent("""
+        import os
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import numpy as np
+        import partiallyshuffledistributedsampler_tpu as psds
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        psds.enable_big_index_space()
+        from partiallyshuffledistributedsampler_tpu.ops import cpu
+        n, w, world = 10_000_000_000, 8192, 2_000_000
+        for rank, epoch in ((0, 0), (3, 5), (1_999_999, 1)):
+            ref = cpu.epoch_indices_np(n, w, 42, epoch, rank, world)
+            got = np.asarray(psds.epoch_indices_jax(n, w, 42, epoch, rank, world))
+            assert got.dtype == np.int64, got.dtype
+            np.testing.assert_array_equal(got, ref)
+        print("X64_PARITY_OK")
+    """)
+    res = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=300,
+    )
+    assert "X64_PARITY_OK" in res.stdout, res.stderr[-2000:]
+
+
+def test_jax_big_n_without_x64_raises():
+    from partiallyshuffledistributedsampler_tpu.ops.xla import epoch_indices_jax
+
+    import jax
+
+    if jax.config.read("jax_enable_x64"):
+        pytest.skip("x64 already on in this process")
+    with pytest.raises(ValueError, match="x64"):
+        epoch_indices_jax(TEN_B, 8192, 0, 0, 0, 2_000_000)

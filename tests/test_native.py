@@ -1,0 +1,54 @@
+"""Native C++ path: builds with the repo toolchain and is bit-identical to
+the numpy reference (the cross-language spec check)."""
+
+import numpy as np
+import pytest
+
+from partiallyshuffledistributedsampler_tpu.ops import cpu, native
+
+
+@pytest.fixture(scope="module", autouse=True)
+def built():
+    try:
+        native.build()
+    except Exception as exc:
+        pytest.skip(f"native toolchain unavailable: {exc}")
+
+
+CONFIGS = [
+    dict(n=50_000, window=512, world=2),
+    dict(n=12_345, window=512, world=8),
+    dict(n=1000, window=1, world=3),
+    dict(n=1000, window=2048, world=3),
+    dict(n=97, window=10, world=3, partition="blocked"),
+    dict(n=5000, window=100, world=4, order_windows=False),
+    dict(n=777, window=33, world=5, shuffle=False),
+    dict(n=640, window=64, world=8, drop_last=True),
+]
+
+
+@pytest.mark.parametrize("cfg", CONFIGS, ids=lambda c: f"n{c['n']}w{c['window']}x{c['world']}")
+@pytest.mark.parametrize("seed,epoch", [(0, 0), ((1 << 40) + 5, 7)])
+def test_native_bit_identical(cfg, seed, epoch):
+    cfg = dict(cfg)
+    n, w, world = cfg.pop("n"), cfg.pop("window"), cfg.pop("world")
+    for rank in range(0, world, max(1, world // 3)):
+        ref = cpu.epoch_indices_np(n, w, seed, epoch, rank, world, **cfg)
+        got = native.epoch_indices_native(n, w, seed, epoch, rank, world, **cfg)
+        assert got.dtype == ref.dtype
+        np.testing.assert_array_equal(got, ref)
+
+
+def test_native_int64_space():
+    n, world = 10_000_000_000, 2_000_000
+    ref = cpu.epoch_indices_np(n, 8192, 9, 1, 7, world)
+    got = native.epoch_indices_native(n, 8192, 9, 1, 7, world)
+    assert got.dtype == np.int64
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_native_validates():
+    with pytest.raises(ValueError):
+        native.epoch_indices_native(10, 4, 0, 0, 9, 4)
+    with pytest.raises(ValueError):
+        native.epoch_indices_native(10, 4, 0, 0, 0, 4, rounds=65)

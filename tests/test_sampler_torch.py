@@ -81,6 +81,20 @@ def test_bad_rank_raises():
         make(world=2, rank=2)
 
 
+def test_native_backend_bit_identical_to_cpu():
+    from partiallyshuffledistributedsampler_tpu.ops import native
+
+    try:
+        native.build()
+    except Exception as exc:
+        pytest.skip(f"native toolchain unavailable: {exc}")
+    a = make(n=2000, world=2, rank=1, backend="cpu", seed=9)
+    b = make(n=2000, world=2, rank=1, backend="native", seed=9)
+    for e in (0, 4):
+        a.set_epoch(e), b.set_epoch(e)
+        assert list(a) == list(b)
+
+
 def test_xla_backend_bit_identical_to_cpu():
     a = make(n=2000, world=2, rank=0, backend="cpu", seed=9)
     b = make(n=2000, world=2, rank=0, backend="xla", seed=9)
