@@ -1,0 +1,90 @@
+"""Driver metric #2: DataLoader stall % as the chip count scales (8 -> 256).
+
+One machine can't run 256 loaders, but the stall mechanism is per-rank and
+the per-rank work shrinks as world grows (num_samples = N/world) — so the
+honest single-host measurement is: for each world size, run ONE rank's full
+epoch loop (DataLoader + synthetic step time) with epoch-boundary regen on
+each backend, and report the probe's stall %.  The epoch-boundary stall is
+where host regen hurts at scale: the xla backend's regen is dispatched async
+by set_epoch and hides entirely.
+
+    python benchmarks/stall_bench.py
+
+JSON line per (backend, world).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N = 2_000_000          # dataset size (kept modest so the cpu backend finishes)
+WINDOW = 8192
+BATCH = 512
+STEP_S = 0.0005        # synthetic per-step compute
+EPOCHS = 3
+
+
+def run(backend: str, world: int) -> dict:
+    import torch
+    from torch.utils.data import DataLoader, TensorDataset
+
+    from partiallyshuffledistributedsampler_tpu import (
+        PartiallyShuffleDistributedSampler,
+    )
+    from partiallyshuffledistributedsampler_tpu.utils import StallProbe
+
+    ds = TensorDataset(torch.arange(N))
+    s = PartiallyShuffleDistributedSampler(
+        ds, num_replicas=world, rank=0, window=WINDOW, backend=backend
+    )
+    loader = DataLoader(ds, batch_size=BATCH, sampler=s)
+    # warmup epoch: jit compile (xla) and allocator warmup are one-time
+    # costs a real job amortizes over its whole run — exclude them
+    s.set_epoch(10_000)
+    for _ in loader:
+        break
+    s.regen_timer.samples_ms.clear()
+    probe = StallProbe(loader)
+    regen_ms = []
+    for epoch in range(EPOCHS):
+        t0 = time.perf_counter()
+        s.set_epoch(epoch)
+        regen_ms.append((time.perf_counter() - t0) * 1e3)
+        for _ in probe:
+            time.sleep(STEP_S)
+    rep = probe.report()
+    rep.update(
+        backend=backend, world=world,
+        regen_dispatch_ms=round(sum(regen_ms) / len(regen_ms), 3),
+        epoch_regen_ms=round(s.regen_timer.mean_ms, 3),
+    )
+    return rep
+
+
+def main() -> None:
+    from partiallyshuffledistributedsampler_tpu.ops import native
+
+    backends = ["cpu", "xla"]
+    try:
+        native.build()
+        backends.insert(1, "native")
+    except Exception:
+        pass
+    for world in (8, 64, 256):
+        for backend in backends:
+            try:
+                print(json.dumps(run(backend, world)), flush=True)
+            except Exception as exc:
+                print(json.dumps({
+                    "backend": backend, "world": world,
+                    "error": repr(exc)[:150],
+                }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -25,10 +25,24 @@ _SO = os.path.join(_CSRC, "libpsds_core.so")
 _lib: Optional[ctypes.CDLL] = None
 
 
+def _unload() -> None:
+    """Drop the loaded handle AND dlclose it — glibc dedups dlopen by
+    pathname, so without the dlclose a rebuilt .so at the same path would
+    silently resolve to the old in-memory mapping."""
+    global _lib
+    if _lib is not None:
+        import _ctypes
+
+        try:
+            _ctypes.dlclose(_lib._handle)
+        except Exception:
+            pass
+        _lib = None
+
+
 def build(force: bool = False) -> str:
     """Compile the extension (make handles staleness, so edits to
     psds_core.cpp always rebuild).  Returns the .so path."""
-    global _lib
     cmd = ["make", "-C", _CSRC] + (["-B"] if force else [])
     res = subprocess.run(cmd, capture_output=True, text=True)
     if res.returncode != 0:
@@ -36,7 +50,7 @@ def build(force: bool = False) -> str:
             f"native build failed (exit {res.returncode}):\n{res.stderr[-2000:]}"
         )
     if "up to date" not in res.stdout:
-        _lib = None  # freshly built: drop any previously loaded handle
+        _unload()  # freshly built: force a real re-dlopen
     return _SO
 
 

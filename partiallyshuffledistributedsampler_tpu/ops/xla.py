@@ -97,4 +97,5 @@ def epoch_indices_jax(
         raise ValueError(f"rank must be in [0, {world}), got {int(rank)}")
     to_u32 = lambda v: jnp.asarray(v).astype(jnp.uint32)
     seed_lo, seed_hi = core.fold_seed(seed)
-    return fn(to_u32(seed_lo), to_u32(seed_hi), to_u32(epoch), to_u32(rank))
+    with jax.profiler.TraceAnnotation("psds_epoch_regen"):
+        return fn(to_u32(seed_lo), to_u32(seed_hi), to_u32(epoch), to_u32(rank))

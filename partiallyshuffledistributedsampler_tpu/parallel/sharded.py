@@ -106,4 +106,11 @@ def sharded_epoch_indices(
         triple = np.asarray(local_seeds, dtype=np.uint32)
         if triple.shape != (world, 3):
             raise ValueError(f"local_seeds must be [world={world}, 3]")
-    return fn(triple)
+    # Build a global device array from the (process-local) numpy triple —
+    # required in multi-process SPMD, harmless single-process.  Every process
+    # holds the same global view; each furnishes only its addressable rows.
+    sharding = NamedSharding(mesh, P(axis, None))
+    triple_arr = jax.make_array_from_callback(
+        triple.shape, sharding, lambda idx: triple[idx]
+    )
+    return fn(triple_arr)

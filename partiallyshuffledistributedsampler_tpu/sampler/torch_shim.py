@@ -130,7 +130,10 @@ class PartiallyShuffleDistributedSampler(_TorchSampler):
         if backend == "native":
             from ..ops import native as _native
 
-            _native.build()  # raises early if the toolchain is missing
+            # a loadable prebuilt .so is enough — only invoke the toolchain
+            # when nothing is loadable, and raise early if that also fails
+            if not _native.available():
+                _native.build()
         self.backend = backend
         self._pending_epoch: Optional[int] = None
         self._pending = None  # in-flight device array for _pending_epoch

@@ -1,4 +1,5 @@
 """Observability utilities: stall probe and regen-latency metrics."""
 
-from .stall_probe import StallProbe  # noqa: F401
+from .checkpoint import load_sampler_state, save_sampler_state  # noqa: F401
 from .metrics import RegenTimer  # noqa: F401
+from .stall_probe import StallProbe  # noqa: F401

@@ -1,0 +1,32 @@
+"""Sampler checkpoint helpers (SURVEY.md §5 checkpoint/resume).
+
+The sampler's whole state is the `(spec_version, seed, epoch, offset)` dict
+from ``state_dict()`` — a plain pytree of scalars, so it drops directly into
+any checkpointing system (orbax `save_pytree`, torch ``torch.save`` training
+state, or these json helpers for standalone use).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+
+
+def save_sampler_state(path: str, state: dict) -> None:
+    """Atomic json write (rename over), safe against mid-write crashes."""
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(state, f)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+def load_sampler_state(path: str) -> dict:
+    with open(path) as f:
+        return json.load(f)

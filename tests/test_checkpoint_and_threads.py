@@ -1,0 +1,69 @@
+"""Checkpoint helpers + concurrency (the 'race defense' of SURVEY.md §5:
+determinism plus a thread-safety check on the jit cache)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from partiallyshuffledistributedsampler_tpu import PartiallyShuffleDistributedSampler
+from partiallyshuffledistributedsampler_tpu.ops import cpu
+from partiallyshuffledistributedsampler_tpu.utils import (
+    load_sampler_state,
+    save_sampler_state,
+)
+
+
+def test_state_roundtrip_through_file(tmp_path):
+    s = PartiallyShuffleDistributedSampler(
+        500, num_replicas=2, rank=0, window=32, seed=11, backend="cpu"
+    )
+    s.set_epoch(6)
+    p = str(tmp_path / "sampler.json")
+    save_sampler_state(p, s.state_dict(consumed=42))
+
+    s2 = PartiallyShuffleDistributedSampler(
+        500, num_replicas=2, rank=0, window=32, backend="cpu"
+    )
+    s2.load_state_dict(load_sampler_state(p))
+    assert s2.seed == 11 and s2.epoch == 6
+    assert list(s2) == cpu.epoch_indices_np(500, 32, 11, 6, 0, 2)[42:].tolist()
+
+
+def test_save_is_atomic(tmp_path):
+    p = str(tmp_path / "s.json")
+    save_sampler_state(p, {"spec_version": 1, "seed": 0, "epoch": 0, "offset": 0})
+    save_sampler_state(p, {"spec_version": 1, "seed": 9, "epoch": 3, "offset": 1})
+    assert load_sampler_state(p)["seed"] == 9
+    # no stray tmp files
+    leftovers = [f for f in tmp_path.iterdir() if f.suffix == ".tmp"]
+    assert not leftovers
+
+
+def test_concurrent_epoch_generation_threads():
+    """Many threads hammering the jitted regen (same + different configs)
+    must all get bit-correct results — guards the lru_cache + jit dispatch
+    path against races (DataLoader workers / prefetch threads do this)."""
+    from partiallyshuffledistributedsampler_tpu.ops.xla import epoch_indices_jax
+
+    errors = []
+
+    def worker(rank, epoch, n):
+        try:
+            got = np.asarray(epoch_indices_jax(n, 64, 5, epoch, rank, 4))
+            ref = cpu.epoch_indices_np(n, 64, 5, epoch, rank, 4)
+            np.testing.assert_array_equal(got, ref)
+        except Exception as exc:  # pragma: no cover
+            errors.append(exc)
+
+    threads = [
+        threading.Thread(target=worker, args=(r, e, n))
+        for r in range(4)
+        for e in range(3)
+        for n in (1000, 2048)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors[:3]

@@ -1,0 +1,88 @@
+"""True multi-process distributed regen: 2 "hosts" x 4 CPU devices each,
+global 8-device mesh via jax.distributed — the DCN-scaling analogue of the
+reference's NCCL/MPI world (SURVEY.md §2 'Distributed communication
+backend').  Each process only sees its own 4 devices; the sharded regen must
+still produce every rank's correct shard, with rank-0's seed winning the
+agreement collective across process boundaries.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+_WORKER = textwrap.dedent("""
+    import os, sys
+    pid = int(sys.argv[1]); port = sys.argv[2]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    sys.path.insert(0, os.getcwd())
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}", num_processes=2, process_id=pid)
+    assert jax.process_count() == 2, jax.process_count()
+    assert len(jax.devices()) == 8, len(jax.devices())   # global view
+    assert len(jax.local_devices()) == 4
+
+    import numpy as np
+    from jax.sharding import Mesh
+    from partiallyshuffledistributedsampler_tpu.ops import cpu
+    from partiallyshuffledistributedsampler_tpu.parallel import (
+        sharded_epoch_indices)
+
+    mesh = Mesh(np.asarray(jax.devices()), ("data",))
+    n, w, seed, epoch = 10_000, 512, 77, 4
+    out = sharded_epoch_indices(mesh, n, w, seed, epoch)
+    # each process checks ITS addressable rows against the host reference
+    for shard in out.addressable_shards:
+        r = shard.index[0].start or 0
+        ref = cpu.epoch_indices_np(n, w, seed, epoch, r, 8)
+        np.testing.assert_array_equal(np.asarray(shard.data)[0], ref)
+    print(f"MULTIHOST_OK pid={pid} rows=" +
+          ",".join(str(s.index[0].start or 0) for s in out.addressable_shards))
+""")
+
+
+@pytest.mark.timeout(300)
+def test_two_process_mesh(tmp_path):
+    port = _free_port()
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(pid), str(port)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("multihost workers timed out")
+        outs.append((p.returncode, out, err))
+    for rc, out, err in outs:
+        assert rc == 0, f"worker failed:\n{err[-3000:]}"
+        assert "MULTIHOST_OK" in out
+    # between them the two processes validated all 8 rows
+    rows = set()
+    for _, out, _ in outs:
+        line = [l for l in out.splitlines() if "MULTIHOST_OK" in l][0]
+        rows.update(int(r) for r in line.split("rows=")[1].split(","))
+    assert rows == set(range(8))
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
