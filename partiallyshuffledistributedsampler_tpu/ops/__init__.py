@@ -10,11 +10,22 @@ from .core import (  # noqa: F401
     swap_or_not,
     windowed_perm,
 )
-from .cpu import epoch_indices_np, full_epoch_stream_np  # noqa: F401
+from .cpu import (  # noqa: F401
+    epoch_indices_np,
+    full_epoch_stream_np,
+    stream_indices_at_np,
+)
 
 
 def epoch_indices_jax(*args, **kwargs):
     """Lazy re-export so importing the package never forces jax init."""
     from .xla import epoch_indices_jax as _impl
+
+    return _impl(*args, **kwargs)
+
+
+def stream_indices_at_jax(*args, **kwargs):
+    """Lazy re-export of the device-side random-access primitive."""
+    from .xla import stream_indices_at_jax as _impl
 
     return _impl(*args, **kwargs)

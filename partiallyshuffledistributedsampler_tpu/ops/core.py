@@ -118,6 +118,16 @@ def fold_seed(seed) -> tuple:
     return (seed, 0)
 
 
+def as_u32_scalar(xp: Any, v):
+    """uint32 scalar from a concrete int (any value, wrapped) or traced
+    scalar — ``xp.asarray`` alone rejects python ints above int32 max."""
+    import numpy as _np
+
+    if isinstance(v, (int, _np.integer)):
+        return xp.asarray(_np.uint32(int(v) & _M32))
+    return xp.asarray(v).astype(xp.uint32)
+
+
 def derive_epoch_key(xp: Any, seed, epoch):
     """Fold ``(seed, epoch)`` into the epoch master key (uint32).
 
@@ -323,6 +333,39 @@ def rank_positions(xp: Any, n: int, rank, world: int, num_samples: int,
     else:
         raise ValueError(f"partition must be 'strided' or 'blocked', got {partition!r}")
     return p % xp.asarray(n, dtype=pos_dtype)
+
+
+def stream_indices_at_generic(
+    xp: Any,
+    positions,
+    n: int,
+    window: int,
+    seed,
+    epoch,
+    *,
+    shuffle: bool = True,
+    order_windows: bool = True,
+    rounds: int = DEFAULT_ROUNDS,
+):
+    """Random access into the epoch stream: ``stream(p) = pi(p mod n)`` for
+    arbitrary position arrays (SPEC.md §4).
+
+    This is the primitive that makes mid-epoch resume, debugging, and
+    billion-scale spot-checking O(len(positions)) instead of O(n/world):
+    the permutation is stateless, so any subset of the stream can be
+    evaluated directly.  ``positions`` may exceed ``total_size`` — values
+    are taken mod n (the wrap-padding law).
+    """
+    pos_dtype = xp.uint32 if n <= 0x7FFFFFFF else xp.uint64
+    out_dtype = xp.int32 if n <= 0x7FFFFFFF else xp.int64
+    p = xp.asarray(positions).astype(pos_dtype) % xp.asarray(n, dtype=pos_dtype)
+    if not shuffle:
+        return p.astype(out_dtype)
+    ek = derive_epoch_key(xp, seed, epoch)
+    return windowed_perm(
+        xp, p, n, window, ek, order_windows=order_windows, rounds=rounds,
+        pos_dtype=pos_dtype,
+    ).astype(out_dtype)
 
 
 def epoch_indices_generic(

@@ -38,6 +38,27 @@ def epoch_indices_np(
     )
 
 
+def stream_indices_at_np(
+    positions,
+    n: int,
+    window: int,
+    seed: int,
+    epoch: int,
+    *,
+    shuffle: bool = True,
+    order_windows: bool = True,
+    rounds: int = core.DEFAULT_ROUNDS,
+) -> np.ndarray:
+    """Random access into the epoch stream (SPEC.md §4) on the host.
+
+    ``stream_indices_at_np(rank + world*np.arange(k), ...)`` reproduces the
+    first k entries of ``epoch_indices_np(...)`` — see the invariant test."""
+    return core.stream_indices_at_generic(
+        np, positions, n, window, int(seed), int(epoch),
+        shuffle=shuffle, order_windows=order_windows, rounds=rounds,
+    )
+
+
 def full_epoch_stream_np(
     n: int,
     window: int,

@@ -154,7 +154,7 @@ def epoch_indices_pallas(
     seed_lo, seed_hi = core.fold_seed(seed)
     scalars = jnp.stack(
         [
-            jnp.asarray(v).astype(jnp.uint32)
+            core.as_u32_scalar(jnp, v)
             for v in (seed_lo, seed_hi, epoch, rank)
         ]
     ).reshape(1, 4)

@@ -63,6 +63,28 @@ def _compiled_epoch_indices(
     return jax.jit(fn)
 
 
+def stream_indices_at_jax(
+    positions,
+    n: int,
+    window: int,
+    seed,
+    epoch,
+    *,
+    shuffle: bool = True,
+    order_windows: bool = True,
+    rounds: int = core.DEFAULT_ROUNDS,
+) -> jax.Array:
+    """Random access into the epoch stream on device (SPEC.md §4) —
+    jit-compatible (call inside your own jit, or use as-is for spot reads)."""
+    seed_lo, seed_hi = core.fold_seed(seed)
+    return core.stream_indices_at_generic(
+        jnp, positions, int(n), int(window),
+        (core.as_u32_scalar(jnp, seed_lo), core.as_u32_scalar(jnp, seed_hi)),
+        core.as_u32_scalar(jnp, epoch),
+        shuffle=shuffle, order_windows=order_windows, rounds=rounds,
+    )
+
+
 def epoch_indices_jax(
     n: int,
     window: int,
@@ -95,7 +117,7 @@ def epoch_indices_jax(
         # traced ranks legitimately can't be checked; concrete ones must be —
         # an out-of-range rank would silently alias another rank's shard
         raise ValueError(f"rank must be in [0, {world}), got {int(rank)}")
-    to_u32 = lambda v: jnp.asarray(v).astype(jnp.uint32)
+    to_u32 = lambda v: core.as_u32_scalar(jnp, v)
     seed_lo, seed_hi = core.fold_seed(seed)
     with jax.profiler.TraceAnnotation("psds_epoch_regen"):
         return fn(to_u32(seed_lo), to_u32(seed_hi), to_u32(epoch), to_u32(rank))
