@@ -335,6 +335,51 @@ def rank_positions(xp: Any, n: int, rank, world: int, num_samples: int,
     return p % xp.asarray(n, dtype=pos_dtype)
 
 
+def remaining_stream_positions(
+    xp: Any,
+    q,
+    old_world: int,
+    old_num_samples: int,
+    consumed: int,
+    partition: str,
+    pos_dtype,
+):
+    """Elastic-resharding position map (SPEC.md §6).
+
+    After every rank of an ``old_world``-rank run has consumed ``consumed``
+    samples of an epoch, the un-consumed part of the global stream is a
+    deterministic set of ``R = (old_num_samples - consumed) * old_world``
+    positions.  This maps remainder ordinals ``q in [0, R)`` (taken mod R by
+    the caller for wrap-padding) to those global stream positions, in
+    ascending order:
+
+      strided:  the consumed set is exactly the prefix ``[0, consumed*old_world)``
+                (rank r took ``r, r+W, ...``), so ``pos(q) = consumed*old_world + q``.
+      blocked:  rank r consumed ``[r*ns, r*ns + consumed)``; the remainder is
+                ``old_world`` gaps of length ``ns - consumed``, so
+                ``pos(q) = (q // gap)*ns + consumed + q % gap``.
+    """
+    if consumed >= old_num_samples:
+        # R = 0: there are no remaining positions; numpy would otherwise
+        # divide by gap=0 in the blocked branch and return silent garbage
+        raise ValueError(
+            f"epoch fully consumed (consumed={consumed} >= "
+            f"num_samples={old_num_samples}); the remainder is empty"
+        )
+    q = xp.asarray(q).astype(pos_dtype)
+    if partition == "strided":
+        return xp.asarray(consumed * old_world, dtype=pos_dtype) + q
+    if partition == "blocked":
+        gap = old_num_samples - consumed
+        gap_p = xp.asarray(gap, dtype=pos_dtype)
+        return (
+            (q // gap_p) * xp.asarray(old_num_samples, dtype=pos_dtype)
+            + xp.asarray(consumed, dtype=pos_dtype)
+            + q % gap_p
+        )
+    raise ValueError(f"partition must be 'strided' or 'blocked', got {partition!r}")
+
+
 def stream_indices_at_generic(
     xp: Any,
     positions,

@@ -44,12 +44,15 @@ def build(force: bool = False) -> str:
     """Compile the extension (make handles staleness, so edits to
     psds_core.cpp always rebuild).  Returns the .so path."""
     cmd = ["make", "-C", _CSRC] + (["-B"] if force else [])
+    mtime_before = os.path.getmtime(_SO) if os.path.exists(_SO) else None
     res = subprocess.run(cmd, capture_output=True, text=True)
     if res.returncode != 0:
         raise RuntimeError(
             f"native build failed (exit {res.returncode}):\n{res.stderr[-2000:]}"
         )
-    if "up to date" not in res.stdout:
+    # mtime compare, not make's "up to date" message — locale-independent
+    mtime_after = os.path.getmtime(_SO) if os.path.exists(_SO) else None
+    if mtime_after != mtime_before:
         _unload()  # freshly built: force a real re-dlopen
     return _SO
 

@@ -21,6 +21,17 @@ import jax.numpy as jnp
 from . import core
 
 
+def _require_x64_for_big_n(n: int) -> None:
+    """n >= 2^31 needs uint64 position math; without x64 jax silently demotes
+    to uint32 and returns wrong indices — refuse loudly instead."""
+    if n > 0x7FFFFFFF and not jax.config.read("jax_enable_x64"):
+        raise ValueError(
+            "index spaces >= 2^31 need uint64 position math: enable x64 "
+            "(jax.config.update('jax_enable_x64', True) or "
+            "partiallyshuffledistributedsampler_tpu.enable_big_index_space())"
+        )
+
+
 @functools.lru_cache(maxsize=None)
 def _compiled_epoch_indices(
     n: int,
@@ -34,12 +45,7 @@ def _compiled_epoch_indices(
     use_pallas: bool,
 ):
     """One compiled executable per static config, cached for the process."""
-    if n > 0x7FFFFFFF and not jax.config.read("jax_enable_x64"):
-        raise ValueError(
-            "index spaces >= 2^31 need uint64 position math: enable x64 "
-            "(jax.config.update('jax_enable_x64', True) or "
-            "partiallyshuffledistributedsampler_tpu.enable_big_index_space())"
-        )
+    _require_x64_for_big_n(n)
 
     if use_pallas:
         from . import pallas_kernel
@@ -76,6 +82,7 @@ def stream_indices_at_jax(
 ) -> jax.Array:
     """Random access into the epoch stream on device (SPEC.md §4) —
     jit-compatible (call inside your own jit, or use as-is for spot reads)."""
+    _require_x64_for_big_n(n)
     seed_lo, seed_hi = core.fold_seed(seed)
     return core.stream_indices_at_generic(
         jnp, positions, int(n), int(window),

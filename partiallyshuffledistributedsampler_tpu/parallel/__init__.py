@@ -1,4 +1,9 @@
 """Mesh-parallel regen: ICI seed agreement + per-device shard generation."""
 
-from .mesh import data_mesh, ensure_distributed, identity_from_mesh  # noqa: F401
+from .mesh import (  # noqa: F401
+    data_mesh,
+    ensure_distributed,
+    identity_from_mesh,
+    local_ranks_from_mesh,
+)
 from .sharded import sharded_epoch_indices  # noqa: F401

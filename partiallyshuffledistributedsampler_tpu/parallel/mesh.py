@@ -49,8 +49,37 @@ def data_mesh(
     return Mesh(np.asarray(devs), (axis_name,))
 
 
+def local_ranks_from_mesh(mesh: Mesh, axis_name: str = "data") -> list[int]:
+    """Sorted ``axis_name`` coordinates of THIS process's devices — the data
+    ranks this process must build samplers for.  Read off the mesh layout
+    itself, so it is correct for any device->process assignment: uneven
+    splits, interleaved orders, multi-axis meshes (a device appearing at
+    several coordinates of the other axes contributes its data coordinate
+    once)."""
+    axis = mesh.axis_names.index(axis_name)
+    pidx = jax.process_index()
+    coords = {
+        int(idx[axis])
+        for idx, d in np.ndenumerate(mesh.devices)
+        if d.process_index == pidx
+    }
+    if not coords:
+        raise ValueError(
+            f"process {pidx} owns no devices in this mesh; identity is "
+            "undefined (construct the mesh from devices of every process)"
+        )
+    return sorted(coords)
+
+
 def identity_from_mesh(mesh: Mesh, axis_name: str = "data") -> tuple[int, int]:
     """(world, this_process_first_rank) for host-side bookkeeping.  Inside
-    shard_map each device derives its own rank via lax.axis_index."""
-    world = int(np.prod([mesh.shape[a] for a in (axis_name,)]))
-    return world, jax.process_index() * max(1, world // jax.process_count())
+    shard_map each device derives its own rank via lax.axis_index.
+
+    ``first_rank`` is the minimum ``axis_name`` coordinate among this
+    process's devices.  A single scalar can only describe a *contiguous*
+    local rank block — when the mesh interleaves processes along the data
+    axis, use :func:`local_ranks_from_mesh` for the full (possibly
+    non-contiguous) rank set instead of assuming
+    ``[first, first + local_count)``."""
+    world = int(mesh.shape[axis_name])
+    return world, local_ranks_from_mesh(mesh, axis_name)[0]

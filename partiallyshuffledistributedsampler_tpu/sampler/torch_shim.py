@@ -10,9 +10,12 @@ produced in HBM and streamed back once per epoch).
 Beyond the reference surface:
 
 * ``state_dict()`` / ``load_state_dict()`` — mid-epoch checkpoint/resume in
-  the torchdata ``StatefulDataLoader`` convention.  State is just
-  ``(seed, epoch, offset)`` because the permutation is stateless and
-  random-access (SURVEY.md §5 "Checkpoint/resume").
+  the torchdata ``StatefulDataLoader`` convention.  The sampler counts what
+  ``__iter__`` has yielded, so a bare ``state_dict()`` mid-epoch is already
+  correct; ``state_dict(consumed=...)`` overrides when the training loop
+  knows better (e.g. DataLoader prefetch means yielded > trained-on).  The
+  full permutation config rides along and is validated on load, so a
+  checkpoint can never silently re-shuffle under a mismatched sampler.
 * epoch *prefetch*: on the xla backend ``set_epoch`` dispatches the regen
   asynchronously, so the device computes next epoch's indices while the host
   finishes the current one; ``__iter__`` only blocks on the final transfer.
@@ -106,7 +109,13 @@ class PartiallyShuffleDistributedSampler(_TorchSampler):
         self.seed = int(seed)
         self.drop_last = bool(drop_last)
         self.window = int(window)
+        if self.window < 1:
+            raise ValueError(f"window must be >= 1, got {self.window}")
         self.order_windows = bool(order_windows)
+        if partition not in ("strided", "blocked"):
+            raise ValueError(
+                f"partition must be 'strided' or 'blocked', got {partition!r}"
+            )
         self.partition = partition
         self.rounds = int(rounds)
         self.num_samples, self.total_size = core.shard_sizes(
@@ -114,6 +123,8 @@ class PartiallyShuffleDistributedSampler(_TorchSampler):
         )
         self.epoch = 0
         self._offset = 0  # resume offset within the current epoch
+        self._consumed = 0  # samples yielded so far this epoch (auto-tracked)
+        self._elastic = None  # remainder-epoch state after a world-size change
         if backend == "auto":
             try:
                 import jax  # noqa: F401
@@ -159,6 +170,10 @@ class PartiallyShuffleDistributedSampler(_TorchSampler):
 
     def _epoch_indices(self, epoch: Optional[int]) -> np.ndarray:
         e = self.epoch if epoch is None else int(epoch)
+        # the elastic remainder regime applies only to the epoch being
+        # resumed; an explicit other epoch is an ordinary full epoch
+        if self._elastic is not None and e == self.epoch:
+            return self._elastic_indices(e)
         if self.backend == "xla":
             if self._pending_epoch == e and self._pending is not None:
                 arr = np.asarray(self._pending)
@@ -183,21 +198,66 @@ class PartiallyShuffleDistributedSampler(_TorchSampler):
         )
 
     # ---------------------------------------------------------- Sampler API
+    #: indices are converted to python ints in chunks of this size, so the
+    #: first batch is dispatchable ~immediately instead of after a full
+    #: O(num_samples) ``.tolist()`` (360 ms at 1e7 per BASELINE.md) — the
+    #: epoch-boundary stall the on-device regen removed must not sneak back
+    #: in through host-side conversion (SURVEY.md §7 hard part 3)
+    STREAM_CHUNK = 65536
+
     def __iter__(self) -> Iterator[int]:
         indices = self.epoch_indices()
         start = self._offset
         self._offset = 0  # a fresh epoch starts at 0 unless state is loaded
-        for i in indices[start:].tolist():
-            yield i
+        self._consumed = start
+        gen_epoch = self.epoch
+        chunk = self.STREAM_CHUNK
+        n_total = indices.shape[0]
+        for cs in range(start, n_total, chunk):
+            # one small tolist per chunk: device->host transfer was already
+            # async (set_epoch), so the only per-chunk cost is int-boxing
+            for i in indices[cs:min(cs + chunk, n_total)].tolist():
+                if self.epoch == gen_epoch:
+                    # a generator from a PREVIOUS epoch (set_epoch already
+                    # advanced, e.g. the prefetch pattern) must not write the
+                    # new epoch's consumed counter
+                    self._consumed += 1
+                yield i
+
+    @property
+    def _effective_num_samples(self) -> int:
+        """num_samples, except on an elastic remainder epoch (SPEC.md §6)
+        where this rank only carries its share of the un-consumed stream."""
+        if self._elastic is not None:
+            return self._elastic["num_samples"]
+        return self.num_samples
 
     def __len__(self) -> int:
-        return self.num_samples
+        # after load_state_dict mid-epoch the next __iter__ yields only the
+        # remainder; report that so DataLoader length / LR-schedule step
+        # counts stay in sync on the resumed epoch (reverts to num_samples
+        # once the resumed epoch starts)
+        return self._effective_num_samples - self._offset
 
     def set_epoch(self, epoch: int) -> None:
         """Set the epoch for deterministic reshuffling (distributed.py:146-157
         [T]).  On the xla backend this *dispatches* the on-device regen
-        immediately (async), overlapping it with whatever the host does next."""
-        self.epoch = int(epoch)
+        immediately (async), overlapping it with whatever the host does next.
+
+        Moving to a *different* epoch also resets the resume offset and the
+        consumed counter — they described the previous epoch, and letting
+        them leak forward would make a checkpoint taken between ``set_epoch``
+        and the first batch silently skip the new epoch — and ends any
+        elastic remainder epoch: from the next epoch on, a resharded sampler
+        is an ordinary sampler of the new world size."""
+        e = int(epoch)
+        if e != self.epoch:
+            self._elastic = None
+            self._offset = 0
+            self._consumed = 0
+        self.epoch = e
+        if self._elastic is not None:
+            return  # remainder epoch regenerates on demand in __iter__
         if self.backend == "xla":
             self._pending = self._generate_device(self.epoch)
             self._pending_epoch = self.epoch
@@ -208,16 +268,165 @@ class PartiallyShuffleDistributedSampler(_TorchSampler):
             except AttributeError:
                 pass
 
-    # ------------------------------------------------------ checkpoint/resume
-    def state_dict(self, consumed: int = 0) -> dict:
-        """Snapshot sampler state.  ``consumed`` = samples already drawn this
-        epoch (the training loop knows it as step*batch_size for this rank)."""
+    # ------------------------------------------------------ elastic reshard
+    def _compute_elastic(self, old_world: int, consumed: int) -> dict:
+        """Validate and describe the remainder of an epoch left over by an
+        ``old_world``-rank run (SPEC.md §6).  Pure — mutates nothing, so
+        callers can finish all validation before committing any state."""
+        old_ns, _ = core.shard_sizes(self.n, old_world, self.drop_last)
+        if not (0 <= consumed <= old_ns):
+            raise ValueError(
+                f"consumed {consumed} outside [0, {old_ns}] for "
+                f"old_world={old_world}"
+            )
+        remaining = (old_ns - consumed) * old_world
+        if self.drop_last:
+            # drop_last promises no duplicates: drop the R mod W tail of the
+            # remainder instead of wrap-padding it (SPEC.md §6)
+            num_samples = remaining // self.num_replicas
+        else:
+            num_samples = -(-remaining // self.num_replicas) if remaining else 0
         return {
+            "old_world": int(old_world),
+            "old_num_samples": int(old_ns),
+            "consumed": int(consumed),
+            "remaining": int(remaining),
+            "num_samples": int(num_samples),
+        }
+
+    def _install_elastic(self, old_world: int, consumed: int) -> None:
+        self._elastic = self._compute_elastic(old_world, consumed)
+        self._pending = None
+        self._pending_epoch = None
+
+    def _elastic_indices(self, epoch: int) -> np.ndarray:
+        """This rank's share of the remainder epoch: strided/blocked partition
+        over the remainder ordinals ``q`` (wrap-padded mod R), each mapped to
+        its global stream position, then through the epoch permutation."""
+        el = self._elastic
+        out_dtype = np.int32 if self.n <= 0x7FFFFFFF else np.int64
+        if el["remaining"] == 0:
+            return np.empty(0, dtype=out_dtype)
+        ns = el["num_samples"]
+        # the ordinal partition over the remainder IS the §4 rank-partition
+        # law with n = R — one implementation, not a hand-rolled copy
+        q = core.rank_positions(
+            np, el["remaining"], self.rank, self.num_replicas, ns,
+            self.partition, np.uint64,
+        )
+        pos = core.remaining_stream_positions(
+            np, q, el["old_world"], el["old_num_samples"], el["consumed"],
+            self.partition, np.uint64,
+        )
+        if self.n <= 0x7FFFFFFF:
+            # values fit: pos < total_size < 2^31 + old_world
+            pos = pos.astype(np.uint32)
+        if self.backend == "xla":
+            from ..ops.xla import stream_indices_at_jax
+
+            return np.asarray(
+                stream_indices_at_jax(
+                    pos, self.n, self.window, self.seed, epoch,
+                    shuffle=self.shuffle, order_windows=self.order_windows,
+                    rounds=self.rounds,
+                )
+            )
+        return np.asarray(
+            core.stream_indices_at_generic(
+                np, pos, self.n, self.window, self.seed, epoch,
+                shuffle=self.shuffle, order_windows=self.order_windows,
+                rounds=self.rounds,
+            ),
+            dtype=out_dtype,
+        )
+
+    @classmethod
+    def reshard_from_state_dict(
+        cls,
+        state: dict,
+        num_replicas: int,
+        rank: int,
+        *,
+        dataset=None,
+        **kwargs,
+    ):
+        """Resume a checkpointed run at a *different* world size (SPEC.md §6).
+
+        Builds a sampler for the new ``(num_replicas, rank)`` with the
+        checkpoint's permutation config, positioned so the current epoch's
+        un-consumed samples — and only those — are served this epoch, split
+        across the new ranks.  From the next ``set_epoch`` on it behaves as
+        an ordinary sampler of the new world size.  Exactly-once coverage
+        (consumed prefix + remainder = one full epoch) is the tested law.
+        """
+        if state.get("spec_version", SPEC_VERSION) != SPEC_VERSION:
+            raise ValueError(
+                f"checkpoint from spec version {state['spec_version']}, "
+                f"this build implements {SPEC_VERSION}; the permutation law "
+                "differs and silent reshuffling would occur"
+            )
+        if state.get("elastic") is not None:
+            raise NotImplementedError(
+                "resharding from a checkpoint taken mid-remainder-epoch is "
+                "not supported; finish the remainder epoch (or reshard from "
+                "the previous ordinary checkpoint)"
+            )
+        required = ("num_replicas", "offset", "n", "seed", "epoch")
+        for f in required:
+            if f not in state:
+                raise ValueError(
+                    f"state_dict lacks {f!r}; elastic reshard needs a "
+                    "checkpoint written by this library (spec >= 1)"
+                )
+        sampler = cls(
+            int(state["n"]) if dataset is None else dataset,
+            num_replicas=num_replicas,
+            rank=rank,
+            shuffle=state.get("shuffle", True),
+            seed=int(state["seed"]),
+            drop_last=state.get("drop_last", False),
+            window=int(state.get("window", core.DEFAULT_WINDOW)),
+            order_windows=state.get("order_windows", True),
+            partition=state.get("partition", "strided"),
+            rounds=int(state.get("rounds", core.DEFAULT_ROUNDS)),
+            **kwargs,
+        )
+        if sampler.n != int(state["n"]):
+            raise ValueError(
+                f"dataset length {sampler.n} != checkpoint n {state['n']}"
+            )
+        sampler.epoch = int(state["epoch"])
+        sampler._install_elastic(int(state["num_replicas"]), int(state["offset"]))
+        return sampler
+
+    # ------------------------------------------------------ checkpoint/resume
+    #: permutation-defining fields carried in state_dict and validated on
+    #: load — a checkpoint loaded into a sampler with any of these changed
+    #: would silently refer its offset into a *different* permutation
+    _CONFIG_FIELDS = (
+        "n", "num_replicas", "window", "rounds", "order_windows",
+        "partition", "shuffle", "drop_last",
+    )
+
+    def state_dict(self, consumed: Optional[int] = None) -> dict:
+        """Snapshot sampler state.  ``consumed`` defaults to the number of
+        samples ``__iter__`` has yielded this epoch (auto-tracked); pass it
+        explicitly when the loop knows the trained-on count is smaller
+        (DataLoader workers prefetch indices ahead of delivered batches)."""
+        state = {
             "spec_version": SPEC_VERSION,
             "seed": self.seed,
             "epoch": self.epoch,
-            "offset": int(consumed),
+            "offset": int(self._consumed if consumed is None else consumed),
         }
+        for f in self._CONFIG_FIELDS:
+            state[f] = getattr(self, f)
+        if self._elastic is not None:
+            state["elastic"] = {
+                "old_world": self._elastic["old_world"],
+                "consumed": self._elastic["consumed"],
+            }
+        return state
 
     def load_state_dict(self, state: dict) -> None:
         if state.get("spec_version", SPEC_VERSION) != SPEC_VERSION:
@@ -226,11 +435,35 @@ class PartiallyShuffleDistributedSampler(_TorchSampler):
                 f"this build implements {SPEC_VERSION}; the permutation law "
                 "differs and silent reshuffling would occur"
             )
-        self.seed = int(state["seed"])
-        self.epoch = int(state["epoch"])
+        for f in self._CONFIG_FIELDS:
+            if f in state and state[f] != getattr(self, f):
+                raise ValueError(
+                    f"checkpoint was written with {f}={state[f]!r} but this "
+                    f"sampler has {f}={getattr(self, f)!r}; the offset would "
+                    "resume into a different permutation (for a deliberate "
+                    "world-size change use reshard_from_state_dict)"
+                )
+        # validate EVERYTHING before assigning anything: a failed load must
+        # leave the sampler exactly as it was (a caller catching the error
+        # would otherwise continue on a silently different permutation)
+        el = state.get("elastic")
+        elastic = (
+            self._compute_elastic(int(el["old_world"]), int(el["consumed"]))
+            if el is not None
+            else None
+        )
+        effective = elastic["num_samples"] if elastic else self.num_samples
         offset = int(state.get("offset", 0))
-        if not (0 <= offset <= self.num_samples):
-            raise ValueError(
-                f"offset {offset} outside [0, {self.num_samples}]"
-            )
+        if not (0 <= offset <= effective):
+            raise ValueError(f"offset {offset} outside [0, {effective}]")
+        seed, epoch = int(state["seed"]), int(state["epoch"])
+        self.seed = seed
+        self.epoch = epoch
+        self._elastic = elastic
+        # the prefetch buffer was dispatched under the PREVIOUS (seed, epoch)
+        # — serving it after a load would be the silent reshuffle this
+        # method's validation exists to prevent
+        self._pending = None
+        self._pending_epoch = None
         self._offset = offset
+        self._consumed = offset

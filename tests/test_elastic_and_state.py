@@ -1,0 +1,466 @@
+"""Elastic resharding (SPEC.md §6) + stateful-sampler behavior.
+
+The elastic law's tested invariant: for any (old_world, new_world) pair —
+including non-divisible ones — the old run's consumed prefix plus the union
+of the new ranks' remainder streams covers the epoch's total_size stream
+positions exactly once, modulo the spec'd wrap-padding duplicates.
+
+Also covers the round-2 stateful fixes: automatic consumption tracking
+(state_dict() with no args mid-epoch), config validation on load, and the
+offset-aware __len__ (ADVICE round 1).
+"""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from partiallyshuffledistributedsampler_tpu import PartiallyShuffleDistributedSampler
+from partiallyshuffledistributedsampler_tpu.ops import core, cpu
+
+
+def _epoch_stream(n, window, seed, epoch, world, drop_last=False, partition="strided"):
+    """Full global epoch stream [0, total_size) as index values (numpy ref)."""
+    num_samples, total = core.shard_sizes(n, world, drop_last)
+    pos = np.arange(total, dtype=np.uint64) % np.uint64(n)
+    return np.asarray(
+        core.stream_indices_at_generic(np, pos, n, window, seed, epoch)
+    )
+
+
+@pytest.mark.parametrize("old_world,new_world", [(4, 3), (3, 5), (8, 2), (5, 7), (2, 2)])
+@pytest.mark.parametrize("partition", ["strided", "blocked"])
+def test_elastic_exactly_once(old_world, new_world, partition):
+    n, window, seed, epoch = 1003, 64, 17, 3
+    consumed = 37  # per old rank, mid-epoch
+
+    old = [
+        PartiallyShuffleDistributedSampler(
+            n, num_replicas=old_world, rank=r, window=window, seed=seed,
+            partition=partition, backend="cpu",
+        )
+        for r in range(old_world)
+    ]
+    for s in old:
+        s.set_epoch(epoch)
+    consumed_vals = []
+    for s in old:
+        it = iter(s)
+        consumed_vals += [next(it) for _ in range(consumed)]
+        it.close()
+    state = old[0].state_dict()  # auto-tracked: consumed==37
+    assert state["offset"] == consumed
+
+    new = [
+        PartiallyShuffleDistributedSampler.reshard_from_state_dict(
+            state, num_replicas=new_world, rank=r, backend="cpu"
+        )
+        for r in range(new_world)
+    ]
+    remainder_vals = []
+    for s in new:
+        got = list(s)
+        assert len(got) == len(s) == s._effective_num_samples
+        remainder_vals += got
+
+    # exactly-once: consumed + remainder == full epoch stream + wrap-pad extras
+    stream = _epoch_stream(n, window, seed, epoch, old_world)
+    R = len(stream) - consumed * old_world
+    ns_new = -(-R // new_world)
+    n_extra = ns_new * new_world - R
+    combined = sorted(consumed_vals + remainder_vals)
+    assert len(combined) == len(stream) + n_extra
+    # the full epoch multiset is covered...
+    full = sorted(stream.tolist())
+    extra = list(combined)
+    for v in full:
+        extra.remove(v)  # raises if missing
+    # ...and the extras are legal wrap-pad values (head of the remainder)
+    remainder_set = set(stream.tolist())
+    assert all(v in remainder_set for v in extra)
+    assert len(extra) == n_extra
+
+
+def test_elastic_epoch_zero_consumed():
+    """Resharding at an epoch boundary (consumed=0) = plain new-world epoch."""
+    n, window, seed = 200, 16, 5
+    s_old = PartiallyShuffleDistributedSampler(
+        n, num_replicas=4, rank=0, window=window, seed=seed, backend="cpu"
+    )
+    s_old.set_epoch(2)
+    state = s_old.state_dict()
+    got = list(
+        PartiallyShuffleDistributedSampler.reshard_from_state_dict(
+            state, num_replicas=2, rank=1, backend="cpu"
+        )
+    )
+    # consumed=0 remainder stream == the padded epoch stream re-partitioned,
+    # which for strided is exactly the ordinary new-world epoch *when the old
+    # padding is world-divisible by the new world*; here total(4)=200=total(2)
+    want = cpu.epoch_indices_np(n, window, seed, 2, 1, 2).tolist()
+    assert got == want
+
+
+def test_elastic_fully_consumed_yields_empty():
+    s_old = PartiallyShuffleDistributedSampler(
+        100, num_replicas=4, rank=0, window=16, backend="cpu"
+    )
+    s_old.set_epoch(1)
+    state = s_old.state_dict(consumed=s_old.num_samples)
+    s_new = PartiallyShuffleDistributedSampler.reshard_from_state_dict(
+        state, num_replicas=3, rank=0, backend="cpu"
+    )
+    assert len(s_new) == 0 and list(s_new) == []
+
+
+@pytest.mark.parametrize("old_world,new_world", [(4, 7), (3, 5)])
+def test_elastic_drop_last_no_duplicates(old_world, new_world):
+    """drop_last's at-most-once promise survives resharding: the remainder
+    tail is dropped instead of wrap-padded (SPEC.md §6)."""
+    n, window, seed, epoch, consumed = 1003, 64, 2, 1, 13
+    old = [
+        PartiallyShuffleDistributedSampler(
+            n, num_replicas=old_world, rank=r, window=window, seed=seed,
+            drop_last=True, backend="cpu",
+        )
+        for r in range(old_world)
+    ]
+    consumed_vals = []
+    for s in old:
+        s.set_epoch(epoch)
+        it = iter(s)
+        consumed_vals += [next(it) for _ in range(consumed)]
+        it.close()
+    state = old[0].state_dict(consumed=consumed)
+    remainder_vals = []
+    for r in range(new_world):
+        remainder_vals += list(
+            PartiallyShuffleDistributedSampler.reshard_from_state_dict(
+                state, num_replicas=new_world, rank=r, backend="cpu"
+            )
+        )
+    combined = consumed_vals + remainder_vals
+    assert len(combined) == len(set(combined))  # at most once — no wrap-pad
+    old_ns = n // old_world
+    R = (old_ns - consumed) * old_world
+    assert len(remainder_vals) == (R // new_world) * new_world  # tail dropped
+
+
+def test_elastic_epoch_indices_other_epoch_is_ordinary():
+    """epoch_indices(E') for E' != the resumed epoch must return the
+    ordinary full epoch, not remainder-shaped indices."""
+    s_old = PartiallyShuffleDistributedSampler(
+        500, num_replicas=2, rank=0, window=32, seed=9, backend="cpu"
+    )
+    s_old.set_epoch(3)
+    state = s_old.state_dict(consumed=100)
+    s = PartiallyShuffleDistributedSampler.reshard_from_state_dict(
+        state, num_replicas=5, rank=3, backend="cpu"
+    )
+    nxt = s.epoch_indices(4)
+    np.testing.assert_array_equal(nxt, cpu.epoch_indices_np(500, 32, 9, 4, 3, 5))
+    # and the resumed epoch itself still serves the remainder
+    assert len(s.epoch_indices()) == s._effective_num_samples
+
+
+def test_load_state_dict_failure_leaves_sampler_untouched():
+    s = PartiallyShuffleDistributedSampler(
+        100, num_replicas=2, rank=0, window=16, seed=5, backend="cpu"
+    )
+    s.set_epoch(2)
+    before = list(s)
+    with pytest.raises(ValueError, match="offset"):
+        s.load_state_dict(
+            {"spec_version": 1, "seed": 9, "epoch": 7, "offset": 10_000}
+        )
+    assert s.seed == 5 and s.epoch == 2 and s._elastic is None
+    assert list(s) == before
+
+
+def test_elastic_next_epoch_is_ordinary():
+    """set_epoch to a different epoch ends the remainder regime."""
+    s_old = PartiallyShuffleDistributedSampler(
+        500, num_replicas=2, rank=0, window=32, seed=9, backend="cpu"
+    )
+    s_old.set_epoch(0)
+    state = s_old.state_dict(consumed=100)
+    s = PartiallyShuffleDistributedSampler.reshard_from_state_dict(
+        state, num_replicas=5, rank=3, backend="cpu"
+    )
+    list(s)  # drain the remainder epoch
+    s.set_epoch(1)
+    assert s._elastic is None
+    assert list(s) == cpu.epoch_indices_np(500, 32, 9, 1, 3, 5).tolist()
+    assert len(s) == s.num_samples
+
+
+def test_elastic_xla_matches_cpu():
+    state = {
+        "spec_version": 1, "seed": 3, "epoch": 2, "offset": 11,
+        "n": 777, "num_replicas": 3, "window": 32, "rounds": 24,
+        "order_windows": True, "partition": "strided", "shuffle": True,
+        "drop_last": False,
+    }
+    got_cpu = list(
+        PartiallyShuffleDistributedSampler.reshard_from_state_dict(
+            state, num_replicas=2, rank=1, backend="cpu"
+        )
+    )
+    got_xla = list(
+        PartiallyShuffleDistributedSampler.reshard_from_state_dict(
+            state, num_replicas=2, rank=1, backend="xla"
+        )
+    )
+    assert got_cpu == got_xla
+
+
+def test_elastic_state_roundtrip_mid_remainder():
+    """A checkpoint taken mid-remainder-epoch resumes exactly (same world)."""
+    s_old = PartiallyShuffleDistributedSampler(
+        400, num_replicas=4, rank=0, window=16, seed=1, backend="cpu"
+    )
+    s_old.set_epoch(5)
+    state = s_old.state_dict(consumed=20)
+    s = PartiallyShuffleDistributedSampler.reshard_from_state_dict(
+        state, num_replicas=3, rank=2, backend="cpu"
+    )
+    it = iter(s)
+    first = [next(it) for _ in range(7)]
+    mid_state = s.state_dict()
+    assert mid_state["elastic"] == {"old_world": 4, "consumed": 20}
+    it.close()
+
+    s2 = PartiallyShuffleDistributedSampler(
+        400, num_replicas=3, rank=2, window=16, seed=1, backend="cpu"
+    )
+    s2.load_state_dict(mid_state)
+    rest = list(s2)
+    full = list(
+        PartiallyShuffleDistributedSampler.reshard_from_state_dict(
+            state, num_replicas=3, rank=2, backend="cpu"
+        )
+    )
+    assert first + rest == full
+
+
+def test_set_epoch_resets_consumed_counter():
+    """Checkpoint between set_epoch(E+1) and the first batch must record
+    offset 0 for the new epoch, not the previous epoch's full count (which
+    would silently skip the whole epoch on resume)."""
+    s = PartiallyShuffleDistributedSampler(
+        100, num_replicas=2, rank=0, window=16, backend="cpu"
+    )
+    s.set_epoch(0)
+    assert len(list(s)) == 50  # epoch 0 fully consumed
+    s.set_epoch(1)
+    state = s.state_dict()
+    assert state == {**state, "epoch": 1, "offset": 0}
+    s2 = PartiallyShuffleDistributedSampler(
+        100, num_replicas=2, rank=0, window=16, backend="cpu"
+    )
+    s2.load_state_dict(state)
+    assert len(list(s2)) == 50  # nothing skipped
+
+
+def test_set_epoch_same_epoch_keeps_resume_offset():
+    """load_state_dict then set_epoch(state['epoch']) — the canonical resume
+    loop — must not wipe the mid-epoch offset."""
+    s = PartiallyShuffleDistributedSampler(
+        100, num_replicas=2, rank=0, window=16, backend="cpu"
+    )
+    s.load_state_dict({"spec_version": 1, "seed": 0, "epoch": 3, "offset": 20})
+    s.set_epoch(3)
+    assert len(list(s)) == 30
+
+
+def test_load_state_dict_discards_stale_xla_prefetch():
+    """A load that changes (seed, epoch) must not serve the previously
+    prefetched device buffer — that would be a silent reshuffle."""
+    s = PartiallyShuffleDistributedSampler(
+        500, num_replicas=2, rank=0, window=32, seed=0, backend="xla"
+    )
+    s.set_epoch(3)  # dispatches the seed-0 epoch-3 regen into _pending
+    s.load_state_dict({"spec_version": 1, "seed": 7, "epoch": 3, "offset": 0})
+    assert s._pending is None
+    assert list(s) == cpu.epoch_indices_np(500, 32, 7, 3, 0, 2).tolist()
+
+
+def test_prefetch_pattern_does_not_corrupt_consumed():
+    """set_epoch(e+1) mid-epoch (the advertised async-prefetch pattern) must
+    not let the still-running epoch-e generator inflate the new epoch's
+    consumed counter."""
+    s = PartiallyShuffleDistributedSampler(
+        300, num_replicas=2, rank=0, window=16, backend="cpu"
+    )
+    s.set_epoch(0)
+    it = iter(s)
+    for _ in range(100):
+        next(it)
+    s.set_epoch(1)  # prefetch next epoch while epoch 0 finishes
+    rest = list(it)
+    assert len(rest) == 50  # epoch 0 drains fully
+    state = s.state_dict()
+    assert (state["epoch"], state["offset"]) == (1, 0)  # nothing skipped
+
+
+def test_remaining_positions_rejects_fully_consumed():
+    with pytest.raises(ValueError, match="fully consumed"):
+        core.remaining_stream_positions(np, np.arange(3), 4, 25, 25, "blocked", np.uint64)
+
+
+def test_reshard_rejects_other_spec_version():
+    state = {
+        "spec_version": 99, "seed": 0, "epoch": 0, "offset": 5, "n": 100,
+        "num_replicas": 2,
+    }
+    with pytest.raises(ValueError, match="spec version"):
+        PartiallyShuffleDistributedSampler.reshard_from_state_dict(
+            state, num_replicas=3, rank=0, backend="cpu"
+        )
+
+
+def test_reshard_missing_field_is_informative():
+    state = {"spec_version": 1, "offset": 5, "n": 100, "num_replicas": 2,
+             "epoch": 0}
+    with pytest.raises(ValueError, match="seed"):
+        PartiallyShuffleDistributedSampler.reshard_from_state_dict(
+            state, num_replicas=3, rank=0, backend="cpu"
+        )
+
+
+def test_reshard_from_mid_remainder_state_rejected():
+    state = {
+        "spec_version": 1, "seed": 0, "epoch": 0, "offset": 5, "n": 100,
+        "num_replicas": 2, "elastic": {"old_world": 4, "consumed": 10},
+    }
+    with pytest.raises(NotImplementedError):
+        PartiallyShuffleDistributedSampler.reshard_from_state_dict(
+            state, num_replicas=3, rank=0, backend="cpu"
+        )
+
+
+# ---------------------------------------------------------------- state fixes
+
+def test_auto_consumption_tracking_partial_iter():
+    s = PartiallyShuffleDistributedSampler(
+        300, num_replicas=2, rank=1, window=32, seed=4, backend="cpu"
+    )
+    s.set_epoch(1)
+    it = iter(s)
+    head = [next(it) for _ in range(13)]
+    state = s.state_dict()  # NO consumed argument
+    assert state["offset"] == 13
+    it.close()
+
+    s2 = PartiallyShuffleDistributedSampler(
+        300, num_replicas=2, rank=1, window=32, backend="cpu"
+    )
+    s2.load_state_dict(state)
+    assert head + list(s2) == cpu.epoch_indices_np(300, 32, 4, 1, 1, 2).tolist()
+
+
+def test_explicit_consumed_still_overrides():
+    s = PartiallyShuffleDistributedSampler(
+        100, num_replicas=1, rank=0, window=16, backend="cpu"
+    )
+    list(s)  # consume all
+    assert s.state_dict()["offset"] == s.num_samples
+    assert s.state_dict(consumed=7)["offset"] == 7
+
+
+def test_state_dict_config_mismatch_rejected():
+    s = PartiallyShuffleDistributedSampler(
+        100, num_replicas=2, rank=0, window=16, backend="cpu"
+    )
+    state = s.state_dict()
+    for field, bad in [
+        ("window", 32), ("num_replicas", 4), ("rounds", 8), ("n", 101),
+        ("order_windows", False), ("partition", "blocked"),
+        ("shuffle", False), ("drop_last", True),
+    ]:
+        s2 = PartiallyShuffleDistributedSampler(
+            100, num_replicas=2, rank=0, window=16, backend="cpu"
+        )
+        broken = dict(state)
+        broken[field] = bad
+        with pytest.raises(ValueError, match=field):
+            s2.load_state_dict(broken)
+
+
+def test_legacy_state_without_config_loads():
+    """Round-1 checkpoints (no config fields) still load."""
+    s = PartiallyShuffleDistributedSampler(
+        100, num_replicas=2, rank=0, window=16, backend="cpu"
+    )
+    s.load_state_dict({"spec_version": 1, "seed": 2, "epoch": 3, "offset": 4})
+    assert (s.seed, s.epoch, s._offset) == (2, 3, 4)
+
+
+def test_len_reflects_resume_offset():
+    s = PartiallyShuffleDistributedSampler(
+        100, num_replicas=2, rank=0, window=16, backend="cpu"
+    )
+    assert len(s) == 50
+    s.load_state_dict(s.state_dict(consumed=20))
+    assert len(s) == 30  # the resumed epoch really yields 30
+    assert len(list(s)) == 30
+    assert len(s) == 50  # reverts once the resumed epoch has begun
+
+
+def test_chunked_streaming_byte_equal():
+    """The chunked __iter__ emits exactly the bulk sequence (VERDICT #3)."""
+    s = PartiallyShuffleDistributedSampler(
+        300_000, num_replicas=2, rank=0, window=1024, seed=8, backend="cpu"
+    )
+    s.set_epoch(2)
+    assert s.STREAM_CHUNK < s.num_samples  # the test actually crosses chunks
+    got = np.fromiter(iter(s), dtype=np.int64, count=s.num_samples)
+    want = cpu.epoch_indices_np(300_000, 1024, 8, 2, 0, 2)
+    np.testing.assert_array_equal(got, want.astype(np.int64))
+
+
+def test_stream_indices_at_jax_guards_big_n_without_x64():
+    """ADVICE round 1 (medium): the random-access path must refuse n >= 2^31
+    when x64 is off instead of silently returning wrong int32 indices."""
+    import jax
+
+    from partiallyshuffledistributedsampler_tpu.ops.xla import stream_indices_at_jax
+
+    if jax.config.read("jax_enable_x64"):
+        pytest.skip("x64 globally enabled; guard not reachable")
+    with pytest.raises(ValueError, match="x64"):
+        stream_indices_at_jax(np.arange(4), 2**31 + 10, 8192, 0, 0)
+
+
+def test_identity_from_mesh_interleaved_assignment(monkeypatch):
+    """identity_from_mesh must read rank off the mesh layout, not assume
+    contiguous equal blocks per process (VERDICT weak #5)."""
+    import jax
+
+    from partiallyshuffledistributedsampler_tpu.parallel import mesh as mesh_mod
+
+    devs = jax.devices()[:8]
+
+    class FakeDev:
+        def __init__(self, d, pidx):
+            self._d = d
+            self.process_index = pidx
+
+        def __getattr__(self, a):
+            return getattr(self._d, a)
+
+    # uneven + interleaved: process 1 owns mesh positions 2 and 5 only
+    owners = [0, 0, 1, 0, 0, 1, 0, 0]
+    fake = np.asarray([FakeDev(d, o) for d, o in zip(devs, owners)], dtype=object)
+    m = jax.sharding.Mesh(fake, ("data",))
+    monkeypatch.setattr(jax, "process_index", lambda: 1)
+    world, first = mesh_mod.identity_from_mesh(m)
+    assert (world, first) == (8, 2)
+    # the full (non-contiguous) rank set is what bookkeeping must use
+    assert mesh_mod.local_ranks_from_mesh(m) == [2, 5]
+    monkeypatch.setattr(jax, "process_index", lambda: 0)
+    assert mesh_mod.identity_from_mesh(m) == (8, 0)
+    assert mesh_mod.local_ranks_from_mesh(m) == [0, 1, 3, 4, 6, 7]
+    monkeypatch.setattr(jax, "process_index", lambda: 7)
+    with pytest.raises(ValueError, match="owns no devices"):
+        mesh_mod.identity_from_mesh(m)

@@ -162,7 +162,13 @@ def test_state_dict_resume_mid_epoch():
 def test_state_dict_roundtrip_fields():
     s = make()
     st = s.state_dict(consumed=5)
-    assert st == {"spec_version": 1, "seed": 0, "epoch": 0, "offset": 5}
+    # dynamic state...
+    assert {k: st[k] for k in ("spec_version", "seed", "epoch", "offset")} == {
+        "spec_version": 1, "seed": 0, "epoch": 0, "offset": 5
+    }
+    # ...plus the permutation config, validated on load (ADVICE round 1)
+    for f in PartiallyShuffleDistributedSampler._CONFIG_FIELDS:
+        assert st[f] == getattr(s, f)
 
 
 def test_load_rejects_other_spec_version():
