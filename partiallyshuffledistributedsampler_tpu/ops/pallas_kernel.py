@@ -31,9 +31,16 @@ from jax.experimental.pallas import tpu as pltpu
 
 from . import core
 
-_SUBLANES = 8
 _LANES = 128
-_TILE = _SUBLANES * _LANES  # one program's output elements
+#: rows of 128 lanes each grid program computes.  (8, 128) is the VPU's
+#: native register shape but makes each program trivially small (1,024
+#: elements -> thousands of grid steps whose dispatch overhead dominates).
+#: A (1024, 128) block keeps the handful of live uint32 temporaries at
+#: 512 KiB each — a few MiB total, well inside the ~16 MiB VMEM — while
+#: cutting the 1e9/256-rank grid to ~30 programs.  Swept on the bench
+#: device at that shape (min of 12 reps): rows 8 -> 0.27 ms, 256 -> 0.32,
+#: 512 -> 0.146, 1024 -> 0.133, 2048 -> 0.22; XLA lowering 0.29-0.49 ms.
+_BLOCK_ROWS = 1024
 
 
 def _index_kernel(
@@ -48,6 +55,7 @@ def _index_kernel(
     order_windows: bool,
     partition: str,
     rounds: int,
+    block_rows: int,
 ):
     seed_lo = scalar_ref[0, 0]
     seed_hi = scalar_ref[0, 1]
@@ -55,9 +63,10 @@ def _index_kernel(
     rank = scalar_ref[0, 3]
     i = jnp.asarray(pl.program_id(0)).astype(jnp.uint32)
 
-    row = jax.lax.broadcasted_iota(jnp.uint32, (_SUBLANES, _LANES), 0)
-    col = jax.lax.broadcasted_iota(jnp.uint32, (_SUBLANES, _LANES), 1)
-    flat = i * jnp.uint32(_TILE) + row * jnp.uint32(_LANES) + col
+    row = jax.lax.broadcasted_iota(jnp.uint32, (block_rows, _LANES), 0)
+    col = jax.lax.broadcasted_iota(jnp.uint32, (block_rows, _LANES), 1)
+    tile = block_rows * _LANES
+    flat = i * jnp.uint32(tile) + row * jnp.uint32(_LANES) + col
 
     # Global stream position for this rank (SPEC.md §4).  Lanes with
     # flat >= num_samples are padding; their (possibly wrapped) garbage is
@@ -81,14 +90,19 @@ def _index_kernel(
 
 @functools.lru_cache(maxsize=None)
 def _build(n, window, world, num_samples, shuffle, order_windows,
-           partition, rounds, interpret):
-    padded = math.ceil(num_samples / _TILE) * _TILE
-    grid = (padded // _TILE,)
+           partition, rounds, interpret, block_rows=_BLOCK_ROWS):
+    # small outputs don't fill one block; shrink it so the interpreter and
+    # tiny configs don't pay for a mostly-padding tile
+    rows_needed = math.ceil(num_samples / _LANES)
+    block_rows = max(8, min(block_rows, math.ceil(rows_needed / 8) * 8))
+    tile = block_rows * _LANES
+    padded = math.ceil(num_samples / tile) * tile
+    grid = (padded // tile,)
     kernel = functools.partial(
         _index_kernel,
         n=n, window=window, world=world, num_samples=num_samples,
         shuffle=shuffle, order_windows=order_windows,
-        partition=partition, rounds=rounds,
+        partition=partition, rounds=rounds, block_rows=block_rows,
     )
     call = pl.pallas_call(
         kernel,
@@ -96,7 +110,7 @@ def _build(n, window, world, num_samples, shuffle, order_windows,
         in_specs=[
             pl.BlockSpec((1, 4), lambda i: (0, 0), memory_space=pltpu.SMEM),
         ],
-        out_specs=pl.BlockSpec((_SUBLANES, _LANES), lambda i: (i, 0)),
+        out_specs=pl.BlockSpec((block_rows, _LANES), lambda i: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((padded // _LANES, _LANES), jnp.int32),
         cost_estimate=pl.CostEstimate(
             # ~13 uint32 VPU ops per element per swap-or-not round, 2 active
@@ -113,6 +127,140 @@ def _build(n, window, world, num_samples, shuffle, order_windows,
         return out.reshape(-1)[:num_samples]
 
     return fn
+
+
+def _amortized_kernel(
+    scalar_ref,  # SMEM uint32[1, 4]: (seed_lo, seed_hi, epoch, rank)
+    kex_ref,     # VMEM uint32[block_rows, 128]: per-element source window id
+    out_ref,     # VMEM int32[block_rows, 128]
+    *,
+    window: int,
+    world: int,
+    m: int,
+    rounds: int,
+    block_rows: int,
+):
+    """Body-lane kernel with the outer bijection hoisted out: the per-element
+    source-window id arrives precomputed (xla.py _amortized_window_ids runs
+    the outer swap-or-not once per WINDOW, not once per element), so this
+    kernel evaluates only the inner bijection — half the rounds of the
+    general kernel.  Valid for strided partition with window % world == 0
+    (see xla.py _amortized_applicable)."""
+    seed_lo = scalar_ref[0, 0]
+    seed_hi = scalar_ref[0, 1]
+    epoch = scalar_ref[0, 2]
+    rank = scalar_ref[0, 3]
+    i = jnp.asarray(pl.program_id(0)).astype(jnp.uint32)
+
+    row = jax.lax.broadcasted_iota(jnp.uint32, (block_rows, _LANES), 0)
+    col = jax.lax.broadcasted_iota(jnp.uint32, (block_rows, _LANES), 1)
+    tile = block_rows * _LANES
+    t = i * jnp.uint32(tile) + row * jnp.uint32(_LANES) + col
+
+    kex = kex_ref[:, :]
+    ek = core.derive_epoch_key(jnp, (seed_lo, seed_hi), epoch)
+    # in-window offset of element t: r0 = rank + world*(t mod m) < window
+    r0 = rank + jnp.uint32(world) * (t % jnp.uint32(m))
+    kin = core.inner_key(jnp, ek, kex)
+    rho = core.swap_or_not(
+        jnp, r0, window, kin, rounds, pair_key=core.inner_pair_key(jnp, ek)
+    )
+    out_ref[:, :] = (kex * jnp.uint32(window) + rho).astype(jnp.int32)
+
+
+@functools.lru_cache(maxsize=None)
+def _build_amortized(n, window, world, body, order_windows, rounds,
+                     interpret, block_rows=_BLOCK_ROWS):
+    m = window // world
+    rows_needed = math.ceil(body / _LANES)
+    block_rows = max(8, min(block_rows, math.ceil(rows_needed / 8) * 8))
+    tile = block_rows * _LANES
+    padded = math.ceil(body / tile) * tile
+    grid = (padded // tile,)
+    kernel = functools.partial(
+        _amortized_kernel,
+        window=window, world=world, m=m, rounds=rounds,
+        block_rows=block_rows,
+    )
+    call = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 4), lambda i: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((block_rows, _LANES), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_rows, _LANES), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((padded // _LANES, _LANES), jnp.int32),
+        cost_estimate=pl.CostEstimate(
+            flops=padded * rounds * 15,
+            bytes_accessed=padded * 8,
+            transcendentals=0,
+        ),
+        interpret=bool(interpret),
+    )
+
+    def fn(scalars, kex):
+        kex_p = jnp.pad(kex, (0, padded - body)).reshape(padded // _LANES,
+                                                         _LANES)
+        return call(scalars, kex_p).reshape(-1)[:body]
+
+    return fn
+
+
+def build_amortized_call(
+    n: int,
+    window: int,
+    world: int,
+    num_samples: int,
+    *,
+    order_windows: bool = True,
+    rounds: int = core.DEFAULT_ROUNDS,
+    interpret: bool | None = None,
+):
+    """Kernel callable for the hoisted-outer-bijection path.  Takes the
+    uint32 (1, 4) scalar block and the per-element window-id vector
+    (uint32[nw*m], from xla._amortized_window_ids) and returns the BODY
+    lanes int32[nw*m]; the caller appends the tail/wrap lanes."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    body = (n // window) * (window // world)
+    return _build_amortized(
+        int(n), int(window), int(world), int(body), bool(order_windows),
+        int(rounds), bool(interpret),
+    )
+
+
+def build_call(
+    n: int,
+    window: int,
+    world: int,
+    *,
+    shuffle: bool = True,
+    drop_last: bool = False,
+    order_windows: bool = True,
+    partition: str = "strided",
+    rounds: int = core.DEFAULT_ROUNDS,
+    interpret: bool | None = None,
+):
+    """The cached kernel callable for a static config.  Takes the uint32
+    (1, 4) scalar block (seed_lo, seed_hi, epoch, rank) and returns
+    int32[num_samples].  ``interpret`` defaults to auto: compiled Mosaic on
+    a TPU backend, the Pallas interpreter elsewhere (so parity tests run on
+    the CPU test platform)."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if n > 0x7FFFFFFF:
+        raise ValueError(
+            "pallas path supports n <= int32 max; use the XLA backend with "
+            "enable_big_index_space() for larger index spaces"
+        )
+    if partition not in ("strided", "blocked"):
+        raise ValueError(f"partition must be 'strided' or 'blocked', got {partition!r}")
+    num_samples, _ = core.shard_sizes(n, world, drop_last)
+    return _build(
+        int(n), int(window), int(world), int(num_samples), bool(shuffle),
+        bool(order_windows), str(partition), int(rounds), bool(interpret),
+    )
 
 
 def epoch_indices_pallas(
@@ -132,24 +280,14 @@ def epoch_indices_pallas(
 ) -> jax.Array:
     """Rank's epoch indices via the fused TPU kernel.  int32[num_samples].
 
-    Same contract as ``epoch_indices_jax`` (which dispatches here under
-    ``use_pallas=True``).  ``interpret`` defaults to auto: compiled Mosaic on
-    a TPU backend, the Pallas interpreter elsewhere (so parity tests run on
-    the CPU test platform).
+    Same contract as ``epoch_indices_jax`` (which routes here under
+    ``use_pallas``, jitted and with single-transfer scalar staging — prefer
+    that entry point; this one dispatches the kernel eagerly).
     """
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
-    if n > 0x7FFFFFFF:
-        raise ValueError(
-            "pallas path supports n <= int32 max; use the XLA backend with "
-            "enable_big_index_space() for larger index spaces"
-        )
-    if partition not in ("strided", "blocked"):
-        raise ValueError(f"partition must be 'strided' or 'blocked', got {partition!r}")
-    num_samples, _ = core.shard_sizes(n, world, drop_last)
-    fn = _build(
-        int(n), int(window), int(world), int(num_samples), bool(shuffle),
-        bool(order_windows), str(partition), int(rounds), bool(interpret),
+    fn = build_call(
+        n, window, world, shuffle=shuffle, drop_last=drop_last,
+        order_windows=order_windows, partition=partition, rounds=rounds,
+        interpret=interpret,
     )
     seed_lo, seed_hi = core.fold_seed(seed)
     scalars = jnp.stack(

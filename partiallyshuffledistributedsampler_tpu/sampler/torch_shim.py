@@ -79,6 +79,10 @@ class PartiallyShuffleDistributedSampler(_TorchSampler):
                    csrc/), 'xla' (on-device JAX), or 'auto' (xla when jax
                    imports, else native when built, else cpu).
     rounds:        swap-or-not round count (SPEC.md §2); default 24.
+    use_pallas:    xla backend only — True / False / 'auto' (default): the
+                   fused Pallas kernel where it wins (real TPU, int32 n),
+                   the generic XLA lowering elsewhere.  Bit-identical either
+                   way; this is purely a speed knob.
 
     ``dataset`` may be any ``Sized`` or a plain ``int`` length — handy for
     shard-index mode where there is no Dataset object (WebDataset config [B]).
@@ -98,6 +102,7 @@ class PartiallyShuffleDistributedSampler(_TorchSampler):
         partition: str = "strided",
         backend: str = "auto",
         rounds: int = core.DEFAULT_ROUNDS,
+        use_pallas="auto",
     ) -> None:
         self.n = int(dataset) if isinstance(dataset, int) else len(dataset)
         self.num_replicas, self.rank = _resolve_identity(num_replicas, rank)
@@ -118,6 +123,11 @@ class PartiallyShuffleDistributedSampler(_TorchSampler):
             )
         self.partition = partition
         self.rounds = int(rounds)
+        if use_pallas not in ("auto", True, False):
+            raise ValueError(
+                f"use_pallas must be True, False or 'auto', got {use_pallas!r}"
+            )
+        self.use_pallas = use_pallas
         self.num_samples, self.total_size = core.shard_sizes(
             self.n, self.num_replicas, self.drop_last
         )
@@ -160,7 +170,7 @@ class PartiallyShuffleDistributedSampler(_TorchSampler):
             self.n, self.window, self.seed, epoch, self.rank,
             self.num_replicas, shuffle=self.shuffle, drop_last=self.drop_last,
             order_windows=self.order_windows, partition=self.partition,
-            rounds=self.rounds,
+            rounds=self.rounds, use_pallas=self.use_pallas,
         )
 
     def epoch_indices(self, epoch: Optional[int] = None) -> np.ndarray:

@@ -419,6 +419,21 @@ def test_chunked_streaming_byte_equal():
     np.testing.assert_array_equal(got, want.astype(np.int64))
 
 
+def test_use_pallas_plumbed_through_shim():
+    """backend='xla' + use_pallas=True must serve the same bits as the
+    default path (VERDICT #10); on the CPU test platform 'auto' resolves to
+    the XLA lowering and True forces the interpreted kernel."""
+    kw = dict(num_replicas=2, rank=1, window=64, seed=3, backend="xla")
+    a = PartiallyShuffleDistributedSampler(3_000, use_pallas=True, **kw)
+    b = PartiallyShuffleDistributedSampler(3_000, use_pallas="auto", **kw)
+    a.set_epoch(2), b.set_epoch(2)
+    assert list(a) == list(b) == cpu.epoch_indices_np(
+        3_000, 64, 3, 2, 1, 2
+    ).tolist()
+    with pytest.raises(ValueError, match="use_pallas"):
+        PartiallyShuffleDistributedSampler(100, use_pallas="yes", **kw)
+
+
 def test_stream_indices_at_jax_guards_big_n_without_x64():
     """ADVICE round 1 (medium): the random-access path must refuse n >= 2^31
     when x64 is off instead of silently returning wrong int32 indices."""

@@ -1,0 +1,102 @@
+"""Compiled (non-interpret) Pallas parity gate — VERDICT round 1, weak #1.
+
+The pytest process is pinned to the CPU platform (conftest.py), where the
+Pallas kernel runs in interpret mode only — a Mosaic *lowering* regression
+would ship green.  This gate spawns a subprocess WITHOUT the CPU override so
+it sees the machine's real device, and asserts the compiled kernel is
+bit-identical to the numpy reference across representative configs (tail
+windows, blocked partition, shuffle off, non-default rounds).  Skips — loudly
+— only when the machine truly has no TPU.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_SCRIPT = r"""
+import sys
+import numpy as np
+import jax
+
+if jax.default_backend() != "tpu":
+    print("NO_TPU", jax.default_backend())
+    sys.exit(0)
+
+from partiallyshuffledistributedsampler_tpu.ops import cpu
+from partiallyshuffledistributedsampler_tpu.ops.pallas_kernel import (
+    epoch_indices_pallas,
+)
+
+CONFIGS = [
+    # n, window, world, rank, seed, epoch, order_windows, partition, rounds, shuffle
+    (100_003, 512, 4, 1, 0, 3, True, "strided", 24, True),
+    (100_003, 512, 4, 3, 9, 0, True, "blocked", 24, True),
+    (65_536, 4096, 8, 2, 7, 1, False, "strided", 24, True),
+    (999, 64, 3, 0, 1, 2, True, "strided", 8, True),
+    (4_000_037, 8192, 256, 17, 0, 5, True, "strided", 24, True),
+    (1_000, 128, 2, 1, 0, 0, True, "strided", 24, False),
+]
+from partiallyshuffledistributedsampler_tpu.ops.xla import epoch_indices_jax
+
+checks = 0
+for n, w, world, rank, seed, epoch, ow, part, rounds, shuf in CONFIGS:
+    ref = cpu.epoch_indices_np(
+        n, w, seed, epoch, rank, world, shuffle=shuf, order_windows=ow,
+        partition=part, rounds=rounds,
+    )
+    got = np.asarray(
+        epoch_indices_pallas(
+            n, w, seed, epoch, rank, world, shuffle=shuf, order_windows=ow,
+            partition=part, rounds=rounds, interpret=False,
+        )
+    )
+    if not np.array_equal(got, ref):
+        bad = np.nonzero(got != ref)[0][:5]
+        print("MISMATCH general-pallas", (n, w, world, rank), bad.tolist(),
+              got[bad].tolist(), ref[bad].tolist())
+        sys.exit(1)
+    checks += 1
+    # the compiled amortized evaluators (pallas hybrid AND fused xla),
+    # where applicable — these are the production 'auto' paths
+    for up in (True, False):
+        got = np.asarray(
+            epoch_indices_jax(
+                n, w, seed, epoch, rank, world, shuffle=shuf,
+                order_windows=ow, partition=part, rounds=rounds,
+                use_pallas=up, amortize=True,
+            )
+        )
+        if not np.array_equal(got, ref):
+            bad = np.nonzero(got != ref)[0][:5]
+            print("MISMATCH amortized", up, (n, w, world, rank), bad.tolist(),
+                  got[bad].tolist(), ref[bad].tolist())
+            sys.exit(1)
+        checks += 1
+print("OK", checks)
+"""
+
+
+def test_compiled_pallas_bit_identical_on_real_device():
+    env = os.environ.copy()
+    # undo the conftest/test-platform overrides: let jax pick the real device
+    env.pop("JAX_PLATFORMS", None)
+    env["XLA_FLAGS"] = " ".join(
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "host_platform_device_count" not in f
+    )
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    res = subprocess.run(
+        [sys.executable, "-c", _SCRIPT], env=env, cwd=repo,
+        capture_output=True, text=True, timeout=600,
+    )
+    out = res.stdout.strip().splitlines()
+    last = out[-1] if out else ""
+    if last.startswith("NO_TPU"):
+        pytest.skip(f"no TPU on this machine ({last}); compiled gate ran "
+                    "interpret-only parity elsewhere")
+    assert res.returncode == 0 and last.startswith("OK"), (
+        f"compiled pallas parity failed:\nstdout: {res.stdout[-2000:]}\n"
+        f"stderr: {res.stderr[-2000:]}"
+    )
