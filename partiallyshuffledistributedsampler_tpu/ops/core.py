@@ -380,6 +380,40 @@ def remaining_stream_positions(
     raise ValueError(f"partition must be 'strided' or 'blocked', got {partition!r}")
 
 
+def compose_remainder_chain(xp: Any, q, chain, partition: str, pos_dtype):
+    """Map ordinals of the innermost remainder domain through a *cascade* of
+    elastic reshard layers to base-epoch stream positions (SPEC.md §6).
+
+    ``chain`` is a sequence of ``(world, num_samples, consumed)`` triples,
+    outermost first: layer 0 partitioned the base epoch stream among
+    ``world_0`` ranks, each of which consumed ``consumed_0`` of its
+    ``num_samples_0`` before the reshard; layer ``i>0`` partitioned the
+    remainder left by layer ``i-1``.  ``q`` holds ordinals in
+    ``[0, R_last)`` where ``R_i = (num_samples_i - consumed_i) * world_i``.
+    Between layers the mapped ordinal is wrapped mod the receiving layer's
+    remaining count — the wrap-padding law applied recursively, so a padded
+    remainder lane duplicates the *head* of the outer remainder exactly as a
+    padded epoch lane duplicates the head of the epoch stream.
+
+    This is what makes reshard-from-mid-remainder (cascading preemptions)
+    expressible without ever materialising an epoch: each layer is O(1) per
+    element, so the whole chain stays random-access.
+    """
+    q = xp.asarray(q).astype(pos_dtype)
+    for i in range(len(chain) - 1, 0, -1):
+        world, ns, consumed = chain[i]
+        q = remaining_stream_positions(
+            xp, q, world, ns, consumed, partition, pos_dtype
+        )
+        w_prev, ns_prev, c_prev = chain[i - 1]
+        r_prev = (ns_prev - c_prev) * w_prev
+        q = q % xp.asarray(r_prev, dtype=pos_dtype)
+    world, ns, consumed = chain[0]
+    return remaining_stream_positions(
+        xp, q, world, ns, consumed, partition, pos_dtype
+    )
+
+
 def stream_indices_at_generic(
     xp: Any,
     positions,

@@ -197,6 +197,81 @@ def _compiled_epoch_indices(
     return jax.jit(fn)
 
 
+@functools.lru_cache(maxsize=None)
+def _compiled_elastic_indices(
+    n: int,
+    window: int,
+    chain: tuple,
+    world: int,
+    num_samples: int,
+    shuffle: bool,
+    order_windows: bool,
+    partition: str,
+    rounds: int,
+):
+    """One compiled executable per elastic-remainder config (SPEC.md §6).
+
+    ``chain`` is the outermost-first tuple of (world, num_samples, consumed)
+    reshard layers; (seed, epoch, rank) ride in the same uint32[4] vector as
+    the ordinary epoch executable, so a 1B-sample remainder epoch costs one
+    async dispatch — not the op-by-op host-orchestrated eager loop the jitted
+    path exists to remove."""
+    _require_x64_for_big_n(n)
+    pos_dtype = jnp.uint32 if n <= 0x7FFFFFFF else jnp.uint64
+    w_last, ns_last, c_last = chain[-1]
+    r_last = (ns_last - c_last) * w_last
+
+    def fn(sv):
+        q = core.rank_positions(
+            jnp, r_last, sv[3], world, num_samples, partition, pos_dtype
+        )
+        pos = core.compose_remainder_chain(jnp, q, chain, partition, pos_dtype)
+        return core.stream_indices_at_generic(
+            jnp, pos, n, window, (sv[0], sv[1]), sv[2],
+            shuffle=shuffle, order_windows=order_windows, rounds=rounds,
+        )
+
+    return jax.jit(fn)
+
+
+def elastic_indices_jax(
+    n: int,
+    window: int,
+    seed,
+    epoch,
+    rank,
+    world: int,
+    num_samples: int,
+    chain,
+    *,
+    shuffle: bool = True,
+    order_windows: bool = True,
+    partition: str = "strided",
+    rounds: int = core.DEFAULT_ROUNDS,
+) -> jax.Array:
+    """Rank's elastic-remainder-epoch indices as a device array.
+
+    Same dispatch discipline as ``epoch_indices_jax``: static config baked
+    into one cached executable, dynamic (seed, epoch, rank) in one uint32[4]
+    host array -> one transfer per call.
+    """
+    import numpy as np
+
+    fn = _compiled_elastic_indices(
+        int(n), int(window), tuple(tuple(int(x) for x in layer) for layer in chain),
+        int(world), int(num_samples), bool(shuffle), bool(order_windows),
+        str(partition), int(rounds),
+    )
+    seed_lo, seed_hi = core.fold_seed(seed)
+    sv = np.array(
+        [int(seed_lo) & 0xFFFFFFFF, int(seed_hi) & 0xFFFFFFFF,
+         int(epoch) & 0xFFFFFFFF, int(rank) & 0xFFFFFFFF],
+        dtype=np.uint32,
+    )
+    with jax.profiler.TraceAnnotation("psds_elastic_regen"):
+        return fn(sv)
+
+
 def stream_indices_at_jax(
     positions,
     n: int,

@@ -65,6 +65,19 @@ def _resolve_identity(num_replicas: Optional[int], rank: Optional[int]):
     return world, r
 
 
+def _elastic_layers_from_state(el):
+    """Normalize a checkpoint's elastic field to [(world, consumed), ...].
+
+    Accepts the current ``{"layers": [[w, c], ...]}`` cascade form and the
+    round-2 single-reshard form ``{"old_world": w, "consumed": c}`` (written
+    by earlier builds of this spec version — same law, one layer)."""
+    if el is None:
+        return None
+    if "layers" in el:
+        return [(int(w), int(c)) for w, c in el["layers"]]
+    return [(int(el["old_world"]), int(el["consumed"]))]
+
+
 class PartiallyShuffleDistributedSampler(_TorchSampler):
     """Partial-shuffle distributed sampler with an on-device XLA backend.
 
@@ -86,6 +99,17 @@ class PartiallyShuffleDistributedSampler(_TorchSampler):
 
     ``dataset`` may be any ``Sized`` or a plain ``int`` length — handy for
     shard-index mode where there is no Dataset object (WebDataset config [B]).
+
+    .. warning:: **Checkpointing with ``DataLoader(num_workers>0)``.**  The
+       auto-tracked consumption counter counts indices the sampler has
+       *yielded*; a multi-worker DataLoader prefetches indices ahead of the
+       batches it delivers (``prefetch_factor * num_workers`` batches by
+       default), so a bare ``state_dict()`` taken mid-epoch records up to
+       that many samples as consumed that the model never trained on —
+       they are silently skipped on resume.  Pass the trained-on count
+       explicitly — ``sampler.state_dict(consumed=steps_done * batch_size)``
+       — whenever ``num_workers > 0``; with ``num_workers=0`` (or the
+       JAX-native ``DeviceEpochIterator``) the default is exact.
     """
 
     def __init__(
@@ -134,6 +158,7 @@ class PartiallyShuffleDistributedSampler(_TorchSampler):
         self.epoch = 0
         self._offset = 0  # resume offset within the current epoch
         self._consumed = 0  # samples yielded so far this epoch (auto-tracked)
+        self._generation = 0  # monotonic token: which iterator owns _consumed
         self._elastic = None  # remainder-epoch state after a world-size change
         if backend == "auto":
             try:
@@ -216,21 +241,24 @@ class PartiallyShuffleDistributedSampler(_TorchSampler):
     STREAM_CHUNK = 65536
 
     def __iter__(self) -> Iterator[int]:
+        # claim the consumed counter for THIS iteration: any later __iter__,
+        # set_epoch or load_state_dict bumps the generation, so a generator
+        # still draining from before (the prefetch pattern, a second live
+        # iterator, a same-epoch state load with a different offset) can
+        # never write a stale count into the next checkpoint
+        self._generation += 1
+        gen = self._generation
         indices = self.epoch_indices()
         start = self._offset
         self._offset = 0  # a fresh epoch starts at 0 unless state is loaded
         self._consumed = start
-        gen_epoch = self.epoch
         chunk = self.STREAM_CHUNK
         n_total = indices.shape[0]
         for cs in range(start, n_total, chunk):
             # one small tolist per chunk: device->host transfer was already
             # async (set_epoch), so the only per-chunk cost is int-boxing
             for i in indices[cs:min(cs + chunk, n_total)].tolist():
-                if self.epoch == gen_epoch:
-                    # a generator from a PREVIOUS epoch (set_epoch already
-                    # advanced, e.g. the prefetch pattern) must not write the
-                    # new epoch's consumed counter
+                if self._generation == gen:
                     self._consumed += 1
                 yield i
 
@@ -262,6 +290,10 @@ class PartiallyShuffleDistributedSampler(_TorchSampler):
         is an ordinary sampler of the new world size."""
         e = int(epoch)
         if e != self.epoch:
+            # a generator still draining the previous epoch is now stale and
+            # must not count into the new epoch; a redundant same-epoch call
+            # leaves the live iterator's counting untouched
+            self._generation += 1
             self._elastic = None
             self._offset = 0
             self._consumed = 0
@@ -279,76 +311,106 @@ class PartiallyShuffleDistributedSampler(_TorchSampler):
                 pass
 
     # ------------------------------------------------------ elastic reshard
-    def _compute_elastic(self, old_world: int, consumed: int) -> dict:
-        """Validate and describe the remainder of an epoch left over by an
-        ``old_world``-rank run (SPEC.md §6).  Pure — mutates nothing, so
-        callers can finish all validation before committing any state."""
-        old_ns, _ = core.shard_sizes(self.n, old_world, self.drop_last)
-        if not (0 <= consumed <= old_ns):
-            raise ValueError(
-                f"consumed {consumed} outside [0, {old_ns}] for "
-                f"old_world={old_world}"
-            )
-        remaining = (old_ns - consumed) * old_world
+    def _compute_elastic(self, layers) -> dict:
+        """Validate and describe a cascade of reshard layers (SPEC.md §6).
+
+        ``layers`` is ``[(world, consumed), ...]`` outermost first: layer 0
+        ran the base epoch at ``world_0`` ranks and each consumed
+        ``consumed_0``; every later layer ran the previous layer's remainder.
+        A single-element cascade is the ordinary one-reshard case.  Pure —
+        mutates nothing, so callers can finish all validation before
+        committing any state."""
+        chain = []
+        domain = None  # None = the base epoch; else the remaining count
+        for world, consumed in layers:
+            world, consumed = int(world), int(consumed)
+            if domain is None:
+                ns, _ = core.shard_sizes(self.n, world, self.drop_last)
+            else:
+                if world < 1:
+                    raise ValueError(f"world must be >= 1, got {world}")
+                # the remainder-epoch length law, replayed for the world
+                # that consumed it: drop_last floors (no duplicates),
+                # otherwise ceil + wrap-pad
+                if self.drop_last:
+                    ns = domain // world
+                else:
+                    ns = -(-domain // world) if domain else 0
+            if not (0 <= consumed <= ns):
+                raise ValueError(
+                    f"consumed {consumed} outside [0, {ns}] for "
+                    f"world={world} in reshard layer {len(chain)}"
+                )
+            chain.append((world, ns, consumed))
+            domain = (ns - consumed) * world
         if self.drop_last:
-            # drop_last promises no duplicates: drop the R mod W tail of the
-            # remainder instead of wrap-padding it (SPEC.md §6)
-            num_samples = remaining // self.num_replicas
+            num_samples = domain // self.num_replicas
         else:
-            num_samples = -(-remaining // self.num_replicas) if remaining else 0
+            num_samples = -(-domain // self.num_replicas) if domain else 0
         return {
-            "old_world": int(old_world),
-            "old_num_samples": int(old_ns),
-            "consumed": int(consumed),
-            "remaining": int(remaining),
+            "chain": tuple(chain),
+            "remaining": int(domain),
             "num_samples": int(num_samples),
         }
 
-    def _install_elastic(self, old_world: int, consumed: int) -> None:
-        self._elastic = self._compute_elastic(old_world, consumed)
+    def _install_elastic(self, layers) -> None:
+        self._elastic = self._compute_elastic(layers)
         self._pending = None
         self._pending_epoch = None
 
     def _elastic_indices(self, epoch: int) -> np.ndarray:
         """This rank's share of the remainder epoch: strided/blocked partition
-        over the remainder ordinals ``q`` (wrap-padded mod R), each mapped to
-        its global stream position, then through the epoch permutation."""
+        over the remainder ordinals ``q`` (wrap-padded mod R), composed
+        through the reshard chain to global stream positions, then through
+        the epoch permutation.  Computed once per (epoch) and cached — a
+        remainder epoch is iterated many times by DataLoader re-entry and at
+        1B-sample scale an uncached regen per ``__iter__`` would reintroduce
+        the host-side latency this framework removes."""
         el = self._elastic
+        cached = el.get("_cache")
+        if cached is not None and cached[0] == epoch:
+            return cached[1]
         out_dtype = np.int32 if self.n <= 0x7FFFFFFF else np.int64
         if el["remaining"] == 0:
             return np.empty(0, dtype=out_dtype)
         ns = el["num_samples"]
-        # the ordinal partition over the remainder IS the §4 rank-partition
-        # law with n = R — one implementation, not a hand-rolled copy
-        q = core.rank_positions(
-            np, el["remaining"], self.rank, self.num_replicas, ns,
-            self.partition, np.uint64,
-        )
-        pos = core.remaining_stream_positions(
-            np, q, el["old_world"], el["old_num_samples"], el["consumed"],
-            self.partition, np.uint64,
-        )
-        if self.n <= 0x7FFFFFFF:
-            # values fit: pos < total_size < 2^31 + old_world
-            pos = pos.astype(np.uint32)
         if self.backend == "xla":
-            from ..ops.xla import stream_indices_at_jax
+            from ..ops.xla import elastic_indices_jax
 
-            return np.asarray(
-                stream_indices_at_jax(
-                    pos, self.n, self.window, self.seed, epoch,
+            arr = np.asarray(
+                elastic_indices_jax(
+                    self.n, self.window, self.seed, epoch, self.rank,
+                    self.num_replicas, ns, el["chain"],
                     shuffle=self.shuffle, order_windows=self.order_windows,
-                    rounds=self.rounds,
+                    partition=self.partition, rounds=self.rounds,
                 )
             )
-        return np.asarray(
-            core.stream_indices_at_generic(
-                np, pos, self.n, self.window, self.seed, epoch,
-                shuffle=self.shuffle, order_windows=self.order_windows,
-                rounds=self.rounds,
-            ),
-            dtype=out_dtype,
-        )
+        else:
+            pos_dtype = np.uint32 if self.n <= 0x7FFFFFFF else np.uint64
+            # the ordinal partition over the remainder IS the §4
+            # rank-partition law with n = R — one implementation, not a
+            # hand-rolled copy
+            q = core.rank_positions(
+                np, el["remaining"], self.rank, self.num_replicas, ns,
+                self.partition, pos_dtype,
+            )
+            pos = core.compose_remainder_chain(
+                np, q, el["chain"], self.partition, pos_dtype
+            )
+            arr = np.asarray(
+                core.stream_indices_at_generic(
+                    np, pos, self.n, self.window, self.seed, epoch,
+                    shuffle=self.shuffle, order_windows=self.order_windows,
+                    rounds=self.rounds,
+                ),
+                dtype=out_dtype,
+            )
+        # the cache is shared across __iter__ calls and public
+        # epoch_indices(); hand out a read-only view so in-place caller
+        # mutation can't silently reorder later iterations of this epoch
+        arr.setflags(write=False)
+        el["_cache"] = (epoch, arr)
+        return arr
 
     @classmethod
     def reshard_from_state_dict(
@@ -375,12 +437,6 @@ class PartiallyShuffleDistributedSampler(_TorchSampler):
                 f"this build implements {SPEC_VERSION}; the permutation law "
                 "differs and silent reshuffling would occur"
             )
-        if state.get("elastic") is not None:
-            raise NotImplementedError(
-                "resharding from a checkpoint taken mid-remainder-epoch is "
-                "not supported; finish the remainder epoch (or reshard from "
-                "the previous ordinary checkpoint)"
-            )
         required = ("num_replicas", "offset", "n", "seed", "epoch")
         for f in required:
             if f not in state:
@@ -406,7 +462,11 @@ class PartiallyShuffleDistributedSampler(_TorchSampler):
                 f"dataset length {sampler.n} != checkpoint n {state['n']}"
             )
         sampler.epoch = int(state["epoch"])
-        sampler._install_elastic(int(state["num_replicas"]), int(state["offset"]))
+        # a checkpoint taken mid-remainder-epoch (cascading preemption) just
+        # deepens the cascade: its own (world, offset) becomes one more layer
+        layers = _elastic_layers_from_state(state.get("elastic")) or []
+        layers = layers + [(int(state["num_replicas"]), int(state["offset"]))]
+        sampler._install_elastic(layers)
         return sampler
 
     # ------------------------------------------------------ checkpoint/resume
@@ -433,8 +493,9 @@ class PartiallyShuffleDistributedSampler(_TorchSampler):
             state[f] = getattr(self, f)
         if self._elastic is not None:
             state["elastic"] = {
-                "old_world": self._elastic["old_world"],
-                "consumed": self._elastic["consumed"],
+                "layers": [
+                    [w, c] for (w, _ns, c) in self._elastic["chain"]
+                ],
             }
         return state
 
@@ -456,12 +517,8 @@ class PartiallyShuffleDistributedSampler(_TorchSampler):
         # validate EVERYTHING before assigning anything: a failed load must
         # leave the sampler exactly as it was (a caller catching the error
         # would otherwise continue on a silently different permutation)
-        el = state.get("elastic")
-        elastic = (
-            self._compute_elastic(int(el["old_world"]), int(el["consumed"]))
-            if el is not None
-            else None
-        )
+        layers = _elastic_layers_from_state(state.get("elastic"))
+        elastic = self._compute_elastic(layers) if layers else None
         effective = elastic["num_samples"] if elastic else self.num_samples
         offset = int(state.get("offset", 0))
         if not (0 <= offset <= effective):
@@ -477,3 +534,4 @@ class PartiallyShuffleDistributedSampler(_TorchSampler):
         self._pending_epoch = None
         self._offset = offset
         self._consumed = offset
+        self._generation += 1  # a draining pre-load generator must not count

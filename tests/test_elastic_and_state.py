@@ -227,7 +227,7 @@ def test_elastic_state_roundtrip_mid_remainder():
     it = iter(s)
     first = [next(it) for _ in range(7)]
     mid_state = s.state_dict()
-    assert mid_state["elastic"] == {"old_world": 4, "consumed": 20}
+    assert mid_state["elastic"] == {"layers": [[4, 20]]}
     it.close()
 
     s2 = PartiallyShuffleDistributedSampler(
@@ -328,15 +328,149 @@ def test_reshard_missing_field_is_informative():
         )
 
 
-def test_reshard_from_mid_remainder_state_rejected():
-    state = {
-        "spec_version": 1, "seed": 0, "epoch": 0, "offset": 5, "n": 100,
-        "num_replicas": 2, "elastic": {"old_world": 4, "consumed": 10},
-    }
-    with pytest.raises(NotImplementedError):
-        PartiallyShuffleDistributedSampler.reshard_from_state_dict(
-            state, num_replicas=3, rank=0, backend="cpu"
+# ------------------------------------------------------- cascading reshards
+
+def _drain(sampler, k):
+    it = iter(sampler)
+    vals = [next(it) for _ in range(k)]
+    it.close()
+    return vals
+
+
+@pytest.mark.parametrize("worlds", [(4, 3, 5), (5, 2, 7), (3, 3, 3)])
+@pytest.mark.parametrize("partition", ["strided", "blocked"])
+def test_cascading_reshard_exactly_once(worlds, partition):
+    """V -> W -> X with both reshards mid-epoch (SPEC.md §6.1): every layer's
+    consumed prefix plus the innermost ranks' remainder streams covers the
+    full epoch stream exactly once, modulo wrap-pad duplicates."""
+    V, W, X = worlds
+    n, window, seed, epoch = 911, 64, 23, 2
+    c1, c2 = 29, 11  # per-rank consumption at layer 0 and layer 1
+
+    old = [
+        PartiallyShuffleDistributedSampler(
+            n, num_replicas=V, rank=r, window=window, seed=seed,
+            partition=partition, backend="cpu",
         )
+        for r in range(V)
+    ]
+    consumed = []
+    for s in old:
+        s.set_epoch(epoch)
+        consumed += _drain(s, c1)
+    state1 = old[0].state_dict(consumed=c1)
+
+    mid = [
+        PartiallyShuffleDistributedSampler.reshard_from_state_dict(
+            state1, num_replicas=W, rank=r, backend="cpu"
+        )
+        for r in range(W)
+    ]
+    for s in mid:
+        assert s._effective_num_samples > c2  # c2 must be mid-remainder
+        consumed += _drain(s, c2)
+    state2 = mid[0].state_dict(consumed=c2)
+    assert state2["elastic"] == {"layers": [[V, c1]]}
+
+    new = [
+        PartiallyShuffleDistributedSampler.reshard_from_state_dict(
+            state2, num_replicas=X, rank=r, backend="cpu"
+        )
+        for r in range(X)
+    ]
+    remainder = []
+    for s in new:
+        assert s._elastic["chain"][0][0] == V
+        assert s._elastic["chain"][1][0] == W
+        got = list(s)
+        assert len(got) == len(s) == s._effective_num_samples
+        remainder += got
+
+    # exactly-once over the base epoch stream, with the wrap-pad extras of
+    # BOTH inner layers drawn from legal stream values
+    stream = _epoch_stream(n, window, seed, epoch, V)
+    combined = sorted(consumed + remainder)
+    full = sorted(stream.tolist())
+    extra = list(combined)
+    for v in full:
+        extra.remove(v)  # raises if any epoch position is missing
+    stream_set = set(stream.tolist())
+    assert all(v in stream_set for v in extra)
+    # pad counts: layer-1 epoch padded R1 -> ns1*W, layer-2 padded R2 -> ns2*X
+    ns0 = -(-n // V)
+    R1 = (ns0 * V) - c1 * V
+    ns1 = -(-R1 // W)
+    R2 = (ns1 - c2) * W
+    ns2 = -(-R2 // X)
+    assert len(extra) == (ns1 * W - R1) + (ns2 * X - R2)
+
+
+def test_cascading_reshard_xla_matches_cpu():
+    state = {
+        "spec_version": 1, "seed": 3, "epoch": 2, "offset": 9,
+        "n": 777, "num_replicas": 3, "window": 32, "rounds": 24,
+        "order_windows": True, "partition": "strided", "shuffle": True,
+        "drop_last": False, "elastic": {"layers": [[5, 40]]},
+    }
+    for rank in range(2):
+        got_cpu = list(
+            PartiallyShuffleDistributedSampler.reshard_from_state_dict(
+                state, num_replicas=2, rank=rank, backend="cpu"
+            )
+        )
+        got_xla = list(
+            PartiallyShuffleDistributedSampler.reshard_from_state_dict(
+                state, num_replicas=2, rank=rank, backend="xla"
+            )
+        )
+        assert got_cpu == got_xla
+
+
+def test_cascading_reshard_checkpoint_roundtrip():
+    """A mid-remainder checkpoint of a cascade resumes exactly."""
+    state = {
+        "spec_version": 1, "seed": 7, "epoch": 4, "offset": 13,
+        "n": 500, "num_replicas": 4, "window": 16, "rounds": 24,
+        "order_windows": True, "partition": "strided", "shuffle": True,
+        "drop_last": False, "elastic": {"layers": [[6, 11]]},
+    }
+    s = PartiallyShuffleDistributedSampler.reshard_from_state_dict(
+        state, num_replicas=2, rank=1, backend="cpu"
+    )
+    head = _drain(s, 8)
+    mid = s.state_dict()
+    assert mid["elastic"] == {"layers": [[6, 11], [4, 13]]}
+    s2 = PartiallyShuffleDistributedSampler(
+        500, num_replicas=2, rank=1, window=16, seed=7, backend="cpu"
+    )
+    s2.load_state_dict(mid)
+    full = list(
+        PartiallyShuffleDistributedSampler.reshard_from_state_dict(
+            state, num_replicas=2, rank=1, backend="cpu"
+        )
+    )
+    assert head + list(s2) == full
+
+
+def test_legacy_single_reshard_state_format_accepted():
+    """Round-2 checkpoints wrote elastic as {"old_world", "consumed"}; the
+    cascade-aware loader must read them as a one-layer chain."""
+    legacy = {
+        "spec_version": 1, "seed": 1, "epoch": 0, "offset": 3, "n": 200,
+        "num_replicas": 2, "window": 16, "rounds": 24, "order_windows": True,
+        "partition": "strided", "shuffle": True, "drop_last": False,
+        "elastic": {"old_world": 4, "consumed": 10},
+    }
+    modern = {**legacy, "elastic": {"layers": [[4, 10]]}}
+    a = PartiallyShuffleDistributedSampler(
+        200, num_replicas=2, rank=0, window=16, seed=1, backend="cpu"
+    )
+    a.load_state_dict(legacy)
+    b = PartiallyShuffleDistributedSampler(
+        200, num_replicas=2, rank=0, window=16, seed=1, backend="cpu"
+    )
+    b.load_state_dict(modern)
+    assert list(a) == list(b)
 
 
 # ---------------------------------------------------------------- state fixes
