@@ -1,9 +1,17 @@
-"""Driver config #1 shape: CIFAR-10-sized dataset, DDP-style 2 ranks,
+"""Driver config #1 shape: CIFAR-10-sized dataset, DDP 2 ranks,
 window=512 — the reference's canonical usage, unchanged except for
 ``backend='xla'`` (BASELINE.json north star: "existing DDP DataLoader
 pipelines are unchanged").
 
-Run: python examples/torch_ddp_example.py
+Real DDP launch (one process per rank, gloo; sampler identity discovered
+from the process group exactly as with torch's own DistributedSampler):
+
+    torchrun --nproc_per_node=2 examples/torch_ddp_example.py
+
+Single-process demo (no torchrun; iterates the ranks sequentially):
+
+    python examples/torch_ddp_example.py
+
 (Uses a synthetic 50k-sample tensor dataset so it runs with no downloads;
 swap in torchvision.datasets.CIFAR10 1:1.)
 """
@@ -25,7 +33,8 @@ from partiallyshuffledistributedsampler_tpu.utils import StallProbe
 N, WORLD, WINDOW, BATCH, EPOCHS = 50_000, 2, 512, 256, 2
 
 
-def run_rank(rank: int) -> None:
+def run_rank(rank: int, ddp: bool = False) -> None:
+    torch.manual_seed(0)  # same synthetic data on every rank
     data = TensorDataset(
         torch.randn(N, 3 * 32 * 32), torch.randint(0, 10, (N,))
     )
@@ -33,10 +42,18 @@ def run_rank(rank: int) -> None:
         torch.nn.Linear(3 * 32 * 32, 64), torch.nn.ReLU(),
         torch.nn.Linear(64, 10),
     )
+    if ddp:
+        model = torch.nn.parallel.DistributedDataParallel(model)
+        # identity comes from the process group — same call a torch
+        # DistributedSampler user writes, just the class swapped
+        sampler = PartiallyShuffleDistributedSampler(
+            data, window=WINDOW, backend="auto"
+        )
+    else:
+        sampler = PartiallyShuffleDistributedSampler(
+            data, num_replicas=WORLD, rank=rank, window=WINDOW, backend="auto"
+        )
     opt = torch.optim.SGD(model.parameters(), lr=0.01)
-    sampler = PartiallyShuffleDistributedSampler(
-        data, num_replicas=WORLD, rank=rank, window=WINDOW, backend="auto"
-    )
     loader = DataLoader(data, batch_size=BATCH, sampler=sampler, num_workers=0)
 
     for epoch in range(EPOCHS):
@@ -55,5 +72,14 @@ def run_rank(rank: int) -> None:
 
 
 if __name__ == "__main__":
-    for r in range(WORLD):  # in real DDP each rank is its own process
-        run_rank(r)
+    if "RANK" in os.environ and "WORLD_SIZE" in os.environ:  # torchrun
+        import torch.distributed as dist
+
+        dist.init_process_group(backend="gloo")
+        try:
+            run_rank(dist.get_rank(), ddp=True)
+        finally:
+            dist.destroy_process_group()
+    else:  # single-process demo: iterate the ranks sequentially
+        for r in range(WORLD):
+            run_rank(r)

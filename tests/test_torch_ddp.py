@@ -1,0 +1,122 @@
+"""Real multi-process torch DDP integration (BASELINE.json configs[0]).
+
+Round-2 verdict: the ``_resolve_identity`` torch.distributed branch
+(``torch_shim.py``) had never executed — the suite leaned entirely on the
+explicit-args testing trick.  This module launches REAL processes with a
+gloo ``init_process_group`` (the contract mirrored from torch
+``distributed.py:75-86`` [T]) and constructs the sampler with
+``num_replicas=None, rank=None`` so identity must come from the process
+group, plus the mixed case (one given, one discovered).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+torch = pytest.importorskip("torch")
+if not torch.distributed.is_available():  # pragma: no cover
+    pytest.skip("torch.distributed unavailable", allow_module_level=True)
+
+_WORKER = textwrap.dedent("""
+    import os, sys, json
+    rank = int(sys.argv[1]); world = int(sys.argv[2]); port = sys.argv[3]
+    sys.path.insert(0, os.getcwd())
+    import torch
+    import torch.distributed as dist
+    dist.init_process_group(
+        backend="gloo", init_method=f"tcp://127.0.0.1:{port}",
+        world_size=world, rank=rank,
+    )
+    from partiallyshuffledistributedsampler_tpu import (
+        PartiallyShuffleDistributedSampler as S,
+    )
+
+    # identity fully discovered from the process group
+    s = S(1003, window=64, seed=9, backend="cpu")
+    assert s.num_replicas == world, s.num_replicas
+    assert s.rank == rank, s.rank
+
+    # mixed case: num_replicas given, rank discovered (and vice versa)
+    s_mixed_a = S(1003, num_replicas=world, window=64, seed=9, backend="cpu")
+    s_mixed_b = S(1003, rank=rank, window=64, seed=9, backend="cpu")
+    assert (s_mixed_a.num_replicas, s_mixed_a.rank) == (world, rank)
+    assert (s_mixed_b.num_replicas, s_mixed_b.rank) == (world, rank)
+
+    # set_epoch coherence across processes: all ranks share (seed, epoch) by
+    # convention; an all_gather of each rank's index stream must form a
+    # disjoint cover of the padded epoch (SURVEY.md §4 invariant 1) — if any
+    # process derived a different permutation the union check fails
+    s.set_epoch(3)
+    mine = torch.tensor(list(s), dtype=torch.int64)
+    got = [torch.zeros_like(mine) for _ in range(world)]
+    dist.all_gather(got, mine)
+    allv = torch.cat(got).tolist()
+    ns, total = len(mine), len(mine) * world
+    assert len(allv) == total
+    base = sorted(range(1003))
+    pool = sorted(allv)
+    for v in base:
+        pool.remove(v)                  # every index present at least once
+    assert all(v in set(allv) for v in pool)   # extras are wrap-pad dupes
+    assert len(pool) == total - 1003
+
+    # epoch variation propagates through the dist-constructed sampler
+    s.set_epoch(4)
+    assert list(s) != mine.tolist()
+
+    dist.barrier()
+    dist.destroy_process_group()
+    print(f"DDP_OK rank={rank}")
+""")
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.mark.timeout(300)
+def test_two_process_gloo_ddp(tmp_path):
+    world = 2
+    port = _free_port()
+    script = tmp_path / "ddp_worker.py"
+    script.write_text(_WORKER)
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # workers never touch jax
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(r), str(world), str(port)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        for r in range(world)
+    ]
+    for r, p in enumerate(procs):
+        try:
+            out, err = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("ddp workers timed out")
+        assert p.returncode == 0, f"rank {r} failed:\n{err[-3000:]}"
+        assert f"DDP_OK rank={r}" in out
+
+
+def test_unresolved_identity_without_dist_raises():
+    """Outside a process group, omitted identity must raise the informative
+    error (not fall back to a silently wrong world of 1)."""
+    from partiallyshuffledistributedsampler_tpu import (
+        PartiallyShuffleDistributedSampler as S,
+    )
+
+    if torch.distributed.is_initialized():  # pragma: no cover
+        pytest.skip("a process group is unexpectedly live")
+    with pytest.raises(RuntimeError, match="not\\s+initialized"):
+        S(100, window=16, backend="cpu")
