@@ -4,20 +4,36 @@ tokenized C4 shard files — the [B] configs 3-4).
 At billion-sample scale the shuffle unit is often the shard file, not the
 sample: shard order is permuted globally (windowed, for locality across a
 storage prefix), samples inside a shard stream sequentially or through a
-small in-memory shuffle buffer.  That is exactly the core law with
+windowed in-shard shuffle.  That is exactly the core law with
 ``n = num_shards`` (SURVEY.md §7 build order #7), so this module is a thin
 vocabulary layer over the same spec — no second shuffle implementation.
+
+The laws here are normative in SPEC.md §7: the per-shard seed derivation,
+the within-shard order (the §3 permutation at ``n = shard_size``), and the
+bounded shuffle-buffer stream are all spec'd and golden-tested, so shard
+streams are checkpoint-stable across builds.
 """
 
 from __future__ import annotations
 
-from typing import Iterator, Optional, Sequence
+from typing import Iterable, Iterator, Optional, Sequence, Union
 
 import numpy as np
 
 from ..ops import core
 from ..ops.cpu import epoch_indices_np
 from .torch_shim import PartiallyShuffleDistributedSampler
+
+#: SPEC.md §7 per-shard seed stride (the 64-bit golden ratio, as used by
+#: splitmix64): shard ``sid`` draws its within-shard permutation from
+#: ``seed XOR (_SHARD_SEED_STRIDE + sid)`` folded per SPEC.md §1.
+_SHARD_SEED_STRIDE = 0x9E3779B97F4A7C15
+
+
+def shard_seed(seed: int, sid: int) -> int:
+    """The spec'd per-shard seed (SPEC.md §7).  Pure; any change is a spec
+    version bump — checkpointed shard streams depend on it."""
+    return int(seed) ^ (_SHARD_SEED_STRIDE + int(sid))
 
 
 class PartialShuffleShardSampler(PartiallyShuffleDistributedSampler):
@@ -33,36 +49,155 @@ class PartialShuffleShardSampler(PartiallyShuffleDistributedSampler):
         super().__init__(int(num_shards), **kwargs)
 
 
+def _within_shard_window(m: int, within_shard_shuffle: Union[bool, int]) -> int:
+    """Resolve the within-shard shuffle option to a §3 window size.
+
+    ``True`` -> the whole shard (window = m, a full in-shard permutation);
+    an ``int`` -> that window (bounded displacement — the decompress-ahead
+    distance a tar reader must buffer); ``False``/``0`` -> sequential.
+    """
+    if within_shard_shuffle is True:
+        return m
+    w = int(within_shard_shuffle)
+    if w < 0:
+        raise ValueError(
+            f"within_shard_shuffle must be bool or >= 0, got {w}"
+        )
+    return min(w, m)
+
+
+def shard_sample_order(
+    sid: int,
+    shard_size: int,
+    *,
+    seed: int = 0,
+    epoch: int = 0,
+    within_shard_shuffle: Union[bool, int] = True,
+    rounds: int = core.DEFAULT_ROUNDS,
+) -> np.ndarray:
+    """Within-shard sample order (local offsets [0, shard_size)) — SPEC.md §7.
+
+    The §3 permutation at ``n = shard_size`` with the spec'd per-shard seed;
+    vectorized (one numpy program per shard, no per-sample Python).
+    """
+    m = int(shard_size)
+    if m <= 0:
+        return np.empty(0, dtype=np.int64)
+    w = _within_shard_window(m, within_shard_shuffle)
+    if w <= 1:
+        return np.arange(m, dtype=np.int64)
+    # bounded mode keeps windows in place (order_windows=False) so every
+    # sample moves strictly less than w from storage order — the §3 bound a
+    # sequential tar reader's decompress-ahead buffer relies on
+    return epoch_indices_np(
+        m, w, shard_seed(seed, sid), epoch, 0, 1, rounds=rounds,
+        order_windows=(within_shard_shuffle is True),
+    ).astype(np.int64)
+
+
+def expand_shard_indices_np(
+    shard_ids: Sequence[int],
+    shard_sizes: Sequence[int],
+    *,
+    seed: int = 0,
+    epoch: int = 0,
+    within_shard_shuffle: Union[bool, int] = True,
+    rounds: int = core.DEFAULT_ROUNDS,
+) -> np.ndarray:
+    """Expand a rank's shard-id stream into global sample indices, vectorized.
+
+    ``shard_sizes[i]`` is the sample count of shard ``i``; the sample index
+    space is the concatenation of shards in id order.  One int64 array out —
+    no per-sample Python on the hot path (the round-2 generator boxed every
+    index through a Python int; at C4-scale shard sizes that re-created the
+    epoch-boundary cost the chunked streaming work had just removed).
+    """
+    sizes = np.asarray(shard_sizes, dtype=np.int64)
+    offsets = np.concatenate([[0], np.cumsum(sizes)[:-1]])
+    parts = []
+    for sid in shard_ids:
+        sid = int(sid)
+        m = int(sizes[sid])
+        if m == 0:
+            continue
+        parts.append(
+            int(offsets[sid])
+            + shard_sample_order(
+                sid, m, seed=seed, epoch=epoch,
+                within_shard_shuffle=within_shard_shuffle, rounds=rounds,
+            )
+        )
+    if not parts:
+        return np.empty(0, dtype=np.int64)
+    return np.concatenate(parts)
+
+
 def expand_shard_indices(
     shard_ids: Sequence[int],
     shard_sizes: Sequence[int],
     *,
     seed: int = 0,
     epoch: int = 0,
-    within_shard_shuffle: bool = True,
+    within_shard_shuffle: Union[bool, int] = True,
     rounds: int = core.DEFAULT_ROUNDS,
 ) -> Iterator[int]:
-    """Expand a rank's shard-id stream into global sample indices.
-
-    ``shard_sizes[i]`` is the sample count of shard ``i``; sample index
-    space is the concatenation of shards in id order.  Within a shard the
-    samples are emitted in keyed-bijection order (window = whole shard) or
-    sequentially — deterministic in (seed, epoch, shard), so resume can
-    replay exactly.
-    """
+    """Generator form of :func:`expand_shard_indices_np` (same law, same
+    order), for pipelines that want an index iterator.  Internally chunked
+    per shard — yields from a vectorized array, never one numpy call per
+    sample."""
     sizes = np.asarray(shard_sizes, dtype=np.int64)
     offsets = np.concatenate([[0], np.cumsum(sizes)[:-1]])
     for sid in shard_ids:
+        sid = int(sid)
         m = int(sizes[sid])
         if m == 0:
             continue
-        if within_shard_shuffle and m > 1:
-            order = epoch_indices_np(
-                m, m, seed ^ (0x9E3779B97F4A7C15 + sid), epoch, 0, 1,
-                rounds=rounds,
-            )
-        else:
-            order = range(m)
-        base = int(offsets[sid])
-        for o in order:
-            yield base + int(o)
+        order = shard_sample_order(
+            sid, m, seed=seed, epoch=epoch,
+            within_shard_shuffle=within_shard_shuffle, rounds=rounds,
+        )
+        yield from (int(offsets[sid]) + order).tolist()
+
+
+def shuffle_buffer(
+    items: Iterable,
+    buffer_size: int,
+    *,
+    seed: int = 0,
+    epoch: int = 0,
+) -> Iterator:
+    """Deterministic bounded shuffle buffer (SPEC.md §7) — the WebDataset
+    ``.shuffle(N)`` stage, reproducible from ``(seed, epoch)``.
+
+    Maintains a buffer of ``buffer_size`` items; each step evicts the slot
+    ``mix32(key ^ step) mod fill`` (key = the §1 epoch key xored with
+    0x51ED270B then mixed) and refills from upstream.  Memory is O(buffer);
+    an item can appear at most ``buffer_size - 1`` positions *early* (hard
+    bound — it must enter the buffer first) and late with geometric tail;
+    replaying the same ``(seed, epoch)`` over the same upstream order
+    reproduces the stream exactly — which is what makes mid-epoch resume
+    possible for sample streams whose shard expansion happens outside the
+    index space (tar readers).
+    """
+    if buffer_size < 1:
+        raise ValueError(f"buffer_size must be >= 1, got {buffer_size}")
+    key = core.mix32(
+        np, core.derive_epoch_key(np, seed, epoch) ^ np.uint32(0x51ED270B)
+    )
+    buf = []
+    step = np.uint32(0)
+    one = np.uint32(1)
+    it = iter(items)
+    for item in it:
+        buf.append(item)
+        if len(buf) < buffer_size:
+            continue
+        j = int(core.mix32(np, key ^ step) % np.uint32(len(buf)))
+        step = step + one
+        buf[j], buf[-1] = buf[-1], buf[j]
+        yield buf.pop()
+    while buf:
+        j = int(core.mix32(np, key ^ step) % np.uint32(len(buf)))
+        step = step + one
+        buf[j], buf[-1] = buf[-1], buf[j]
+        yield buf.pop()

@@ -1,0 +1,164 @@
+"""Shard-index mode (SPEC.md §7) — golden-pinned laws + properties.
+
+The golden values freeze the per-shard seed derivation (§7.1), the
+within-shard order (§7.2, both full and bounded), and the shuffle-buffer
+stream (§7.3): any change to those laws breaks checkpointed shard streams
+and must show up here as a failed golden, forcing a spec version bump.
+"""
+
+import numpy as np
+import pytest
+
+from partiallyshuffledistributedsampler_tpu.sampler.shard_mode import (
+    PartialShuffleShardSampler,
+    expand_shard_indices,
+    expand_shard_indices_np,
+    shard_sample_order,
+    shard_seed,
+    shuffle_buffer,
+)
+
+_SIZES = [5, 0, 7, 3, 4]  # shard 1 empty; offsets 0,5,5,12,15
+
+
+# ------------------------------------------------------------------- goldens
+
+def test_golden_shard_seed_frozen():
+    assert shard_seed(3, 2) == 11400714819323198484
+    assert shard_seed(0, 0) == 0x9E3779B97F4A7C15
+
+
+def test_golden_within_shard_order_frozen():
+    assert shard_sample_order(2, 7, seed=3, epoch=1).tolist() == [
+        5, 3, 6, 1, 2, 4, 0
+    ]
+
+
+def test_golden_expand_frozen():
+    got = expand_shard_indices_np([2, 0, 3], _SIZES, seed=3, epoch=1)
+    assert got.tolist() == [10, 8, 11, 6, 7, 9, 5, 1, 2, 0, 3, 4, 13, 12, 14]
+
+
+def test_golden_expand_bounded_frozen():
+    got = expand_shard_indices_np(
+        [2, 0, 3], _SIZES, seed=3, epoch=1, within_shard_shuffle=2
+    )
+    assert got.tolist() == [5, 6, 8, 7, 9, 10, 11, 0, 1, 3, 2, 4, 12, 13, 14]
+
+
+def test_golden_shuffle_buffer_frozen():
+    assert list(shuffle_buffer(range(12), 4, seed=5, epoch=0)) == [
+        3, 4, 1, 5, 0, 6, 8, 2, 11, 9, 10, 7
+    ]
+
+
+# ---------------------------------------------------------------- properties
+
+def test_expand_is_partition_of_selected_shards():
+    """The expansion is a permutation of exactly the selected shards' global
+    index ranges."""
+    got = expand_shard_indices_np([2, 0, 3], _SIZES, seed=9, epoch=4)
+    want = sorted(list(range(5, 12)) + list(range(0, 5)) + list(range(12, 15)))
+    assert sorted(got.tolist()) == want
+
+
+def test_generator_matches_vectorized():
+    for kw in (dict(), dict(within_shard_shuffle=2),
+               dict(within_shard_shuffle=False)):
+        gen = list(expand_shard_indices([2, 0, 3], _SIZES, seed=3, epoch=1, **kw))
+        vec = expand_shard_indices_np([2, 0, 3], _SIZES, seed=3, epoch=1, **kw)
+        assert gen == vec.tolist()
+
+
+def test_bounded_mode_displacement_strictly_bounded():
+    b = 16
+    order = shard_sample_order(0, 1000, seed=7, epoch=2,
+                               within_shard_shuffle=b)
+    disp = np.abs(order - np.arange(1000))
+    assert disp.max() < b
+    assert disp.max() > 0  # actually shuffles
+
+
+def test_sequential_modes():
+    for flag in (False, 0, 1):
+        got = shard_sample_order(4, 9, seed=1, epoch=0,
+                                 within_shard_shuffle=flag)
+        assert got.tolist() == list(range(9))
+
+
+def test_empty_shards_skipped():
+    got = expand_shard_indices_np([1, 1], _SIZES, seed=0, epoch=0)
+    assert got.tolist() == []
+
+
+def test_epoch_changes_shard_orders():
+    a = expand_shard_indices_np([2], _SIZES, seed=3, epoch=0)
+    b = expand_shard_indices_np([2], _SIZES, seed=3, epoch=1)
+    assert a.tolist() != b.tolist()
+    assert sorted(a.tolist()) == sorted(b.tolist())
+
+
+def test_shards_have_independent_orders():
+    """Equal-sized shards must not share a permutation (the per-shard seed
+    exists exactly for this)."""
+    a = shard_sample_order(0, 64, seed=3, epoch=0)
+    b = shard_sample_order(1, 64, seed=3, epoch=0)
+    assert a.tolist() != b.tolist()
+
+
+# ------------------------------------------------------------ shuffle buffer
+
+def test_shuffle_buffer_is_permutation_and_bounded():
+    n, B = 500, 32
+    out = list(shuffle_buffer(range(n), B, seed=1, epoch=2))
+    assert sorted(out) == list(range(n))
+    # the hard bound: when output position k is emitted, upstream has been
+    # read only to position k + B - 1, so out[k] - k <= B - 1 (an item can
+    # be pulled at most B-1 ahead of schedule); lateness (out[k] < k) is
+    # geometric-tailed, not bounded
+    ahead = np.asarray(out) - np.arange(n)
+    assert ahead.max() <= B - 1
+    assert np.abs(ahead).max() > 0
+
+
+def test_shuffle_buffer_deterministic_and_epoch_varying():
+    a = list(shuffle_buffer(range(100), 8, seed=4, epoch=0))
+    b = list(shuffle_buffer(range(100), 8, seed=4, epoch=0))
+    c = list(shuffle_buffer(range(100), 8, seed=4, epoch=1))
+    assert a == b
+    assert a != c
+
+
+def test_shuffle_buffer_size_one_is_identity():
+    assert list(shuffle_buffer(range(20), 1, seed=0, epoch=0)) == list(range(20))
+
+
+def test_shuffle_buffer_rejects_bad_size():
+    with pytest.raises(ValueError, match="buffer_size"):
+        list(shuffle_buffer(range(5), 0))
+
+
+# ----------------------------------------------------- end-to-end shard mode
+
+def test_shard_sampler_to_samples_pipeline():
+    """The [B] config-4 shape: shard sampler per rank -> expansion -> global
+    sample indices; ranks' shard sets are disjoint and cover."""
+    num_shards, world = 37, 4
+    sizes = [(3 + 7 * s) % 11 + 1 for s in range(num_shards)]
+    all_shards = []
+    for r in range(world):
+        s = PartialShuffleShardSampler(
+            num_shards, num_replicas=world, rank=r, window=8, seed=5,
+            backend="cpu",
+        )
+        s.set_epoch(2)
+        shards = list(s)
+        all_shards += shards
+        samples = expand_shard_indices_np(shards, sizes, seed=5, epoch=2)
+        assert len(samples) == sum(sizes[i] for i in shards)
+    # disjoint cover with wrap-pad duplicates (SURVEY.md §4 invariant 1)
+    base = list(range(num_shards))
+    pool = sorted(all_shards)
+    for v in base:
+        pool.remove(v)
+    assert len(pool) == -(-num_shards // world) * world - num_shards
