@@ -1,0 +1,452 @@
+"""Driver metric #2 — data-pipeline stall %, measured credibly.
+
+The round-2 harness (stall_bench.py) reported raw StallProbe fractions that
+BASELINE.md itself conceded were 70-90 % DataLoader tensor-collation and
+emulator-tunnel noise in *every* backend — useless for attributing cost to
+the sampler.  This harness replaces it with a noise-subtracted design, in
+two tiers:
+
+1. **JAX-native** (`native_stall`): the framework's strongest story —
+   indices never leave the device.  A synthetic jitted train step (two
+   batch x dim x dim matmuls, donated params) consumes per-step index
+   batches from `DeviceEpochIterator` across several epoch boundaries.  The
+   *same* compiled step then runs the identical loop shape with a constant
+   index batch (zero data cost).  Both runs force genuine completion by
+   fetching the final loss (the param chain threads every step, so queue
+   order == completion order — the bench.py round-2 discipline).  The stall
+   attributable to the data pipeline is the wall-clock difference:
+
+       stall_pct = 100 * (T_sampler - T_constant) / T_sampler
+
+   Everything else — dispatch overhead, compute, tunnel — is common mode
+   and cancels.  Epoch boundaries are *included* in the timed region, and
+   because the loop runs only `steps_cap` steps per epoch (a full 1e9/8
+   epoch is 244k steps), the boundary regen has far *less* compute to hide
+   behind than in a real job — the reported stall is an upper bound.
+
+2. **torch shim** (`torch_stall`): the same subtraction through the real
+   DataLoader: our sampler vs a precomputed-constant sampler of identical
+   length, identical DataLoader config and synthetic step.  The collation
+   noise that drowned round 2's numbers is now common mode.
+
+The reference has no stall instrumentation at all (SURVEY.md §5); its host
+`torch.randperm` regen is a synchronous epoch-boundary stall by
+construction (94 s at 1e9 — BASELINE.md).  Scaling story 8 -> 256 chips:
+per-rank work shrinks as n/world while the regen our design must hide
+shrinks with it and is dispatched async by `set_epoch`/`epoch()`.
+
+Standalone: ``python benchmarks/stall_native.py`` (one JSON line per
+configuration).  bench.py imports and embeds the summaries.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_NATIVE = 1_000_000_000
+N_TORCH = 2_000_000
+WINDOW = 8192
+BATCH = 512
+DIM = 256
+STEPS_CAP = 32       # steps actually run per epoch (boundary included)
+EPOCHS = 3
+REPS = 3
+STEP_S = 0.0005      # torch tier synthetic per-step compute
+
+
+def make_step(dim: int = DIM):
+    """Jitted synthetic train step: two [batch,dim]@[dim,dim] matmuls whose
+    param chain threads every step (so fetching the last loss forces the
+    whole queue to completion)."""
+    import jax
+    import jax.numpy as jnp
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def step(W, idx):
+        x = (idx.astype(jnp.float32) % dim) / dim
+        v = x[:, None] * jnp.ones((dim,), jnp.float32)[None, :]
+        h = v @ W
+        return W + 1e-6 * (v.T @ h), h.sum()
+
+    return step
+
+
+def make_fused_step(batch: int, dim: int = DIM):
+    """The production pattern (models/train.py, jax_iterator.
+    batch_index_window): the epoch index tensor stays in HBM and the step's
+    batch is sliced INSIDE the jitted step — per-step data cost is zero
+    extra dispatches.  Same math as make_step."""
+    import jax
+    import jax.numpy as jnp
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def fstep(W, epoch_idx, start):
+        idx = jax.lax.dynamic_slice(epoch_idx, (start,), (batch,))
+        x = (idx.astype(jnp.float32) % dim) / dim
+        v = x[:, None] * jnp.ones((dim,), jnp.float32)[None, :]
+        h = v @ W
+        return W + 1e-6 * (v.T @ h), h.sum()
+
+    return fstep
+
+
+def native_stall(world: int, *, n: int = N_NATIVE, window: int = WINDOW,
+                 batch: int = BATCH, steps_cap: int = STEPS_CAP,
+                 steady_steps: int = 256, epochs: int = EPOCHS,
+                 reps: int = REPS, epoch_base: int = 100) -> dict:
+    """Noise-subtracted stall metrics for the JAX-native path at one world.
+
+    Three directly-measured quantities, then an explicit composition:
+
+    * **steady-state per-step overhead** — `steady_steps` steps inside one
+      already-regenerated epoch (no boundary in the timed region), sampler
+      iterator vs constant batch; the delta / steps is the per-step cost of
+      the index pipeline (the eager slice dispatch + Python iterator).
+    * **epoch-boundary cost** — regen async-dispatch latency (what the loop
+      pays) and forced-completion latency (what a synchronous host-style
+      design would pay), min over reps after a compile-absorbing warmup.
+    * **capped-run stall %** — the raw multi-epoch subtraction with only
+      `steps_cap` steps/epoch.  Deliberately pessimistic on this rig: the
+      emulator's fixed ~100 ms completion latency per regen has almost no
+      compute to hide behind at 32 steps/epoch, where a real epoch at
+      world=256 is ~7.6k steps.  Reported under that explicit label.
+
+    The full-epoch stall — the driver metric — composes these over the TRUE
+    steps/epoch (n/world/batch):
+
+        compute_ms  = full_steps * const_per_step_ms
+        overhead_ms = full_steps * per_step_overhead_ms
+                      + max(0, regen_completed_ms - compute_ms)   # prefetch
+        stall_pct_epoch = 100 * overhead_ms / (compute_ms + overhead_ms)
+
+    i.e. per-step pipeline cost always counts; the boundary regen counts
+    only where an epoch's compute cannot cover the prefetched regen.
+    """
+    import jax.numpy as jnp
+    import numpy as np
+
+    from partiallyshuffledistributedsampler_tpu.sampler.jax_iterator import (
+        DeviceEpochIterator,
+    )
+
+    it = DeviceEpochIterator(n, window, batch, seed=0, rank=0, world=world)
+    steps = min(steps_cap, it.steps_per_epoch)
+    step = make_step()
+    const_idx = jnp.arange(batch, dtype=jnp.int32)
+
+    def run(use_sampler: bool, base: int) -> float:
+        it._cache.clear()
+        W = jnp.zeros((DIM, DIM), jnp.float32)
+        loss = None
+        t0 = time.perf_counter()
+        for e in range(base, base + epochs):
+            if use_sampler:
+                gen = it.epoch(e)
+                for _, idx_b in zip(range(steps), gen):
+                    W, loss = step(W, idx_b)
+                gen.close()
+            else:
+                for _ in range(steps):
+                    W, loss = step(W, const_idx)
+        float(loss)  # forces completion of the whole step chain
+        # drain the iterator's last prefetch too — it was dispatched on our
+        # behalf, so its completion is honestly part of the sampler loop
+        for a in it._cache.values():
+            np.asarray(a[:1])
+        return time.perf_counter() - t0
+
+    # warmup: compile the step, the regen executable, and the slice program
+    run(True, epoch_base)
+    run(False, epoch_base)
+
+    t_s, t_c = [], []
+    for r in range(1, reps + 1):
+        t_s.append(run(True, epoch_base + r * (epochs + 2)))
+        t_c.append(run(False, epoch_base))
+    t_s.sort(), t_c.sort()
+    ts, tc = t_s[len(t_s) // 2], t_c[len(t_c) // 2]
+
+    # steady state: one pre-completed epoch, no boundary in the timed region
+    sit = DeviceEpochIterator(n, window, batch, seed=0, rank=0, world=world,
+                              prefetch_next_epoch=False)
+    n_steady = min(steady_steps, sit.steps_per_epoch)
+
+    def run_steady(use_sampler: bool) -> float:
+        arr = sit.epoch_array(epoch_base + 50)
+        np.asarray(arr[:1])  # regen fully completed before the clock starts
+        sit._cache[epoch_base + 50] = arr
+        W = jnp.zeros((DIM, DIM), jnp.float32)
+        loss = None
+        t0 = time.perf_counter()
+        if use_sampler:
+            gen = sit.epoch(epoch_base + 50)
+            for _, idx_b in zip(range(n_steady), gen):
+                W, loss = step(W, idx_b)
+            gen.close()
+        else:
+            for _ in range(n_steady):
+                W, loss = step(W, const_idx)
+        float(loss)
+        return time.perf_counter() - t0
+
+    run_steady(True), run_steady(False)  # warmup
+    ss = min(run_steady(True) for _ in range(reps))
+    sc = min(run_steady(False) for _ in range(reps))
+    per_step_overhead_ms = max(ss - sc, 0.0) * 1e3 / n_steady
+    const_per_step_ms = sc * 1e3 / n_steady
+
+    # diagnostic arm: constant batch + ONE trivial eager op per step.  If
+    # its per-step delta matches the iterator arm's, the iterator overhead
+    # is this rig's per-dispatch cost (the eager slice), not slice work —
+    # on real TPU hardware that dispatch is tens of microseconds.
+    def run_diag() -> float:
+        W = jnp.zeros((DIM, DIM), jnp.float32)
+        loss = None
+        t0 = time.perf_counter()
+        for _ in range(n_steady):
+            dummy = const_idx + 1  # the extra eager dispatch, nothing else
+            W, loss = step(W, dummy)
+        float(loss)
+        return time.perf_counter() - t0
+
+    run_diag()  # warmup
+    sd = min(run_diag() for _ in range(reps))
+    extra_eager_dispatch_ms = max(sd - sc, 0.0) * 1e3 / n_steady
+
+    # fused tier — the production pattern: batch sliced INSIDE the jitted
+    # step, zero extra dispatches per step; both arms run the IDENTICAL
+    # executable (const arm passes a device-resident zeros tensor), so the
+    # steady-state delta isolates pure data-pipeline cost.
+    import numpy as _np
+
+    fstep = make_fused_step(batch)
+    zeros_idx = jnp.zeros((it.num_samples,), jnp.int32)
+
+    def run_fused(use_sampler: bool, base: int, nsteps: int,
+                  n_epochs: int, boundary: bool) -> float:
+        it._cache.clear()
+        W = jnp.zeros((DIM, DIM), jnp.float32)
+        loss = None
+        if not boundary:  # steady: pre-complete the epoch array
+            arr = it.epoch_array(base)
+            np.asarray(arr[:1])
+            it._cache[base] = arr
+        t0 = time.perf_counter()
+        for e in range(base, base + n_epochs):
+            if use_sampler:
+                arr = it.epoch_array(e)
+                if boundary:  # the iterator's prefetch, same discipline
+                    it._cache[e + 1] = it._regen(e + 1)
+            else:
+                arr = zeros_idx
+            for s in range(nsteps):
+                W, loss = fstep(W, arr, _np.int32(s * batch))
+        float(loss)
+        for a in it._cache.values():
+            np.asarray(a[:1])
+        return time.perf_counter() - t0
+
+    run_fused(True, epoch_base + 20, steps, epochs, True)   # warmup
+    run_fused(False, epoch_base + 20, steps, epochs, True)
+    fts = min(run_fused(True, epoch_base + 20 + 7 * r, steps, epochs, True)
+              for r in range(1, reps + 1))
+    ftc = min(run_fused(False, epoch_base + 20, steps, epochs, True)
+              for _ in range(reps))
+    fss = min(run_fused(True, epoch_base + 40, n_steady, 1, False)
+              for _ in range(reps))
+    fsc = min(run_fused(False, epoch_base + 40, n_steady, 1, False)
+              for _ in range(reps))
+    fused_per_step_overhead_ms = max(fss - fsc, 0.0) * 1e3 / n_steady
+    fused_const_per_step_ms = fsc * 1e3 / n_steady
+
+    # epoch boundary, the two ways to account it (min of `reps`, after a
+    # warmup rep that absorbs the one-time slice-program compiles):
+    #  - dispatch: what the loop actually pays at the boundary (async)
+    #  - completed: what a synchronous host-style design would pay
+    boundary_dispatch_ms = regen_completed_ms = float("inf")
+    for r in range(reps + 1):
+        it._cache.clear()
+        t0 = time.perf_counter()
+        gen = it.epoch(epoch_base + 60 + 2 * r)
+        first = next(gen)
+        dt = (time.perf_counter() - t0) * 1e3
+        gen.close()
+        np.asarray(first[:1])
+        t0 = time.perf_counter()
+        arr = it._regen(epoch_base + 61 + 2 * r)
+        np.asarray(arr[:8])
+        dt2 = (time.perf_counter() - t0) * 1e3
+        if r > 0:  # rep 0 is warmup
+            boundary_dispatch_ms = min(boundary_dispatch_ms, dt)
+            regen_completed_ms = min(regen_completed_ms, dt2)
+
+    # the composition over the true epoch length (formula in the docstring)
+    full_steps = it.steps_per_epoch
+
+    def compose(step_overhead_ms: float, base_step_ms: float) -> float:
+        compute_ms = full_steps * base_step_ms
+        overhead_ms = full_steps * step_overhead_ms + max(
+            0.0, regen_completed_ms - compute_ms
+        )
+        return 100.0 * overhead_ms / (compute_ms + overhead_ms)
+
+    return {
+        "world": world,
+        "n": n,
+        "full_steps_per_epoch": full_steps,
+        "fused": {  # the production pattern — the headline number
+            "stall_pct_epoch": round(
+                compose(fused_per_step_overhead_ms, fused_const_per_step_ms), 3
+            ),
+            "per_step_overhead_ms": round(fused_per_step_overhead_ms, 4),
+            "const_per_step_ms": round(fused_const_per_step_ms, 4),
+            "capped_sampler_wall_s": round(fts, 4),
+            "capped_constant_wall_s": round(ftc, 4),
+            "stall_pct_capped": round(max(fts - ftc, 0.0) / fts * 100.0, 2),
+        },
+        "iterator": {  # the convenience API (one eager slice dispatch/step)
+            "stall_pct_epoch": round(
+                compose(per_step_overhead_ms, const_per_step_ms), 3
+            ),
+            "per_step_overhead_ms": round(per_step_overhead_ms, 4),
+            "const_per_step_ms": round(const_per_step_ms, 4),
+            "capped_sampler_wall_s": round(ts, 4),
+            "capped_constant_wall_s": round(tc, 4),
+            "stall_pct_capped": round(max(ts - tc, 0.0) / ts * 100.0, 2),
+        },
+        "extra_eager_dispatch_ms": round(extra_eager_dispatch_ms, 4),
+        "boundary_dispatch_ms": round(boundary_dispatch_ms, 3),
+        "regen_completed_ms": round(regen_completed_ms, 3),
+        "capped_steps_per_epoch": steps,
+    }
+
+
+class _ConstantSampler:
+    """Zero-cost sampler of a fixed length — the subtraction baseline for
+    the torch tier.  Identical DataLoader machinery, no index-gen work."""
+
+    def __init__(self, length: int):
+        self._idx = list(range(length))
+
+    def __iter__(self):
+        return iter(self._idx)
+
+    def __len__(self):
+        return len(self._idx)
+
+    def set_epoch(self, epoch: int) -> None:  # same call surface
+        pass
+
+
+def torch_stall(world: int, backend: str, *, n: int = N_TORCH,
+                window: int = WINDOW, batch: int = BATCH,
+                step_s: float = STEP_S, epochs: int = EPOCHS,
+                reps: int = 2) -> dict:
+    """Noise-subtracted stall % through the real torch DataLoader.
+
+    Runs interleaved (constant, ours) pairs and takes the per-arm minimum —
+    single-run DataLoader jitter on a 1-vCPU host otherwise swamps the
+    few-ms sampler delta being measured.
+    """
+    import torch
+    from torch.utils.data import DataLoader, TensorDataset
+
+    from partiallyshuffledistributedsampler_tpu import (
+        PartiallyShuffleDistributedSampler,
+    )
+
+    ds = TensorDataset(torch.arange(n))
+    ours = PartiallyShuffleDistributedSampler(
+        ds, num_replicas=world, rank=0, window=window, backend=backend
+    )
+    const = _ConstantSampler(len(ours))
+
+    def run(sampler) -> float:
+        loader = DataLoader(ds, batch_size=batch, sampler=sampler)
+        sampler.set_epoch(10_000)  # warmup epoch: compile/alloc one-time costs
+        for _ in loader:
+            break
+        t0 = time.perf_counter()
+        for e in range(epochs):
+            sampler.set_epoch(e)
+            for _ in loader:
+                time.sleep(step_s)
+        return time.perf_counter() - t0
+
+    # interleaved pairs so slow host-load drift hits both arms equally
+    tcs, tss = [], []
+    for _ in range(reps):
+        tcs.append(run(const))
+        tss.append(run(ours))
+    tc, ts = min(tcs), min(tss)
+    return {
+        "world": world,
+        "backend": backend,
+        "n": n,
+        "sampler_wall_s": round(ts, 4),
+        "constant_wall_s": round(tc, 4),
+        "stall_pct": round(max(ts - tc, 0.0) / ts * 100.0, 2),
+        # the duty-cycle-free quantities: what the sampler costs per epoch
+        # vs what an epoch's data+step work is at this n/world
+        "sampler_overhead_ms_per_epoch": round(
+            max(ts - tc, 0.0) * 1e3 / epochs, 3
+        ),
+        "epoch_wall_ms": round(tc * 1e3 / epochs, 3),
+        "epoch_regen_ms": round(ours.regen_timer.mean_ms, 3)
+        if ours.regen_timer.samples_ms else None,
+    }
+
+
+def summarize(worlds=(8, 64, 256), torch_backends=("cpu", "xla")) -> dict:
+    """The bench.py embed: stall % per world for the native tier and per
+    (backend, world) for the torch tier."""
+    out: dict = {"native": {}, "torch": {}}
+    for w in worlds:
+        try:
+            r = native_stall(w)
+            out["native"][str(w)] = {
+                "stall_pct_epoch": r["fused"]["stall_pct_epoch"],
+                "iterator_stall_pct_epoch": r["iterator"]["stall_pct_epoch"],
+                "fused_per_step_overhead_ms":
+                    r["fused"]["per_step_overhead_ms"],
+                "extra_eager_dispatch_ms": r["extra_eager_dispatch_ms"],
+                "boundary_dispatch_ms": r["boundary_dispatch_ms"],
+                "regen_completed_ms": r["regen_completed_ms"],
+            }
+        except Exception as exc:
+            out["native"][str(w)] = {"error": repr(exc)[:150]}
+    for b in torch_backends:
+        for w in worlds:
+            try:
+                r = torch_stall(w, b)
+                out["torch"][f"{b}_{w}"] = {
+                    "stall_pct": r["stall_pct"],
+                    "sampler_overhead_ms_per_epoch":
+                        r["sampler_overhead_ms_per_epoch"],
+                    "epoch_wall_ms": r["epoch_wall_ms"],
+                }
+            except Exception as exc:
+                out["torch"][f"{b}_{w}"] = {"error": repr(exc)[:150]}
+    return out
+
+
+def main() -> None:
+    for w in (8, 64, 256):
+        print(json.dumps(native_stall(w)), flush=True)
+    for b in ("cpu", "native", "xla"):
+        for w in (8, 64, 256):
+            try:
+                print(json.dumps(torch_stall(w, b)), flush=True)
+            except Exception as exc:
+                print(json.dumps({"backend": b, "world": w,
+                                  "error": repr(exc)[:150]}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

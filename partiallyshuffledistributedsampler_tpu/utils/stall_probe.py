@@ -54,9 +54,16 @@ class StallProbe:
             self.batches += 1
             # the generator suspends at yield and resumes when the consumer
             # asks for the next item — so (resume - t_yield) IS the
-            # consumer's compute time for this batch
+            # consumer's compute time for this batch.  A consumer that
+            # `break`s out never resumes normally; CPython closes the
+            # abandoned generator at the break (GeneratorExit lands at the
+            # yield), which is the moment the last batch's compute ends.
             t_yield = time.perf_counter()
-            yield item
+            try:
+                yield item
+            except GeneratorExit:
+                self.compute_s += time.perf_counter() - t_yield
+                raise
             self.compute_s += time.perf_counter() - t_yield
 
     def report(self) -> dict:

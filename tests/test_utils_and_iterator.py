@@ -47,6 +47,38 @@ def test_stall_probe_reset():
     assert probe.batches == 0 and probe.stall_fraction == 0.0
 
 
+def test_stall_probe_early_break_counts_last_compute():
+    # a consumer that `break`s never resumes the generator normally; the
+    # close at the break must still book the final batch's compute
+    probe = StallProbe(_ticks(10))
+    for i, _ in enumerate(probe):
+        time.sleep(0.005)
+        if i == 2:
+            break
+    assert probe.batches == 3
+    assert probe.compute_s >= 3 * 0.004  # all three sleeps counted
+    assert probe.stall_fraction < 0.5
+
+
+def test_stall_native_harness_cpu_smoke():
+    # the bench's noise-subtracted stall harness runs end-to-end at toy
+    # sizes and reports the composed metrics (real numbers come from the
+    # bench on the real device; this guards the machinery)
+    from benchmarks.stall_native import native_stall, torch_stall
+
+    r = native_stall(2, n=4096, window=64, batch=32, steps_cap=3,
+                     steady_steps=8, epochs=2, reps=1)
+    for key in ("fused", "iterator"):
+        assert 0.0 <= r[key]["stall_pct_epoch"] <= 100.0
+        assert r[key]["per_step_overhead_ms"] >= 0.0
+    assert r["regen_completed_ms"] > 0.0
+    assert r["full_steps_per_epoch"] == 4096 // 2 // 32
+
+    t = torch_stall(4, "cpu", n=4096, window=64, batch=32, epochs=2, reps=1)
+    assert 0.0 <= t["stall_pct"] <= 100.0
+    assert t["sampler_overhead_ms_per_epoch"] >= 0.0
+
+
 def test_regen_timer():
     t = RegenTimer()
     with t.measure():
