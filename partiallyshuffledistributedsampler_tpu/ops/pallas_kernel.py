@@ -41,6 +41,12 @@ _LANES = 128
 #: device at that shape (min of 12 reps): rows 8 -> 0.27 ms, 256 -> 0.32,
 #: 512 -> 0.146, 1024 -> 0.133, 2048 -> 0.22; XLA lowering 0.29-0.49 ms.
 _BLOCK_ROWS = 1024
+#: the amortized kernel is lighter per element (inner bijection only, plus
+#: the compact window-id read), so smaller blocks pipeline better; swept on
+#: the bench device at 1e9/8192 across worlds 8/32/256 (2026-07-30):
+#: rows 64-256 are within noise of each other, 512+ clearly worse (e.g.
+#: world=8: 29-35 ms wall at 64-256 vs 41-52 ms at 512-2048).
+_BLOCK_ROWS_AMORTIZED = 128
 
 
 def _index_kernel(
@@ -131,7 +137,7 @@ def _build(n, window, world, num_samples, shuffle, order_windows,
 
 def _amortized_kernel(
     scalar_ref,  # SMEM uint32[1, 4]: (seed_lo, seed_hi, epoch, rank)
-    kex_ref,     # VMEM uint32[block_rows, 128]: per-element source window id
+    kex_ref,     # VMEM uint32: compact window ids — see _expand_window_ids
     out_ref,     # VMEM int32[block_rows, 128]
     *,
     window: int,
@@ -140,12 +146,16 @@ def _amortized_kernel(
     rounds: int,
     block_rows: int,
 ):
-    """Body-lane kernel with the outer bijection hoisted out: the per-element
-    source-window id arrives precomputed (xla.py _amortized_window_ids runs
-    the outer swap-or-not once per WINDOW, not once per element), so this
-    kernel evaluates only the inner bijection — half the rounds of the
-    general kernel.  Valid for strided partition with window % world == 0
-    (see xla.py _amortized_applicable)."""
+    """Body-lane kernel with the outer bijection hoisted out: the source
+    window ids arrive as a COMPACT array (one id per window slot, nw
+    elements total — xla.py _amortized_window_ids runs the outer swap-or-not
+    once per WINDOW, not once per element) and are expanded to per-element
+    ids inside the kernel (_expand_window_ids), so the only HBM traffic
+    besides the output write is ~4/m bytes per element.  The kernel then
+    evaluates only the inner bijection — half the rounds of the general
+    kernel.  Valid for strided partition with window % world == 0 and
+    m = window/world a divisor or multiple of the 128-lane dimension
+    (see xla.py _amortized_applicable / _compact_kex_applicable)."""
     seed_lo = scalar_ref[0, 0]
     seed_hi = scalar_ref[0, 1]
     epoch = scalar_ref[0, 2]
@@ -157,7 +167,7 @@ def _amortized_kernel(
     tile = block_rows * _LANES
     t = i * jnp.uint32(tile) + row * jnp.uint32(_LANES) + col
 
-    kex = kex_ref[:, :]
+    kex = _expand_window_ids(kex_ref[:, :], m, block_rows)
     ek = core.derive_epoch_key(jnp, (seed_lo, seed_hi), epoch)
     # in-window offset of element t: r0 = rank + world*(t mod m) < window
     r0 = rank + jnp.uint32(world) * (t % jnp.uint32(m))
@@ -168,15 +178,60 @@ def _amortized_kernel(
     out_ref[:, :] = (kex * jnp.uint32(window) + rho).astype(jnp.int32)
 
 
+def _expand_window_ids(ku, m: int, block_rows: int):
+    """Expand the compact per-slot window ids to per-element ids, entirely
+    in VMEM/registers.
+
+    Output flat position t (row-major over the (block_rows, 128) tile) has
+    window slot t // m, so:
+
+    * ``m < 128`` (slots change within a row): ku arrives as
+      (block_rows, g) with g = 128/m — row r holds the g slot ids of output
+      row r — and is expanded by g lane-broadcast+selects (pure uint32 VPU
+      work; a one-hot f32 MXU matmul also expresses this but miscompiles
+      for narrow operands on this Mosaic version, and g is small anyway).
+    * ``m >= 128`` (a slot spans whole rows): ku arrives as
+      (block_rows, 1) — the slot id of each output row — and expansion is a
+      lane broadcast.
+    """
+    if m >= _LANES:
+        return jnp.broadcast_to(ku, (block_rows, _LANES))
+    g = _LANES // m
+    c_iota = jax.lax.broadcasted_iota(jnp.uint32, (block_rows, _LANES), 1)
+    sel = c_iota // jnp.uint32(m)
+    kex = jnp.zeros((block_rows, _LANES), jnp.uint32)
+    for s in range(g):
+        v = jnp.broadcast_to(ku[:, s:s + 1], (block_rows, _LANES))
+        kex = jnp.where(sel == jnp.uint32(s), v, kex)
+    return kex
+
+
+def compact_kex_applicable(window: int, world: int) -> bool:
+    """Whether the in-kernel window-id expansion covers this config:
+    m = window/world must divide or be divisible by the 128-lane dim, and
+    the select-chain expansion (m < 128) is capped at g = 128/m <= 16
+    selects — below m=8 the expansion cost rivals the inner bijection
+    itself and the XLA amortized evaluator is the better fit.  The
+    headline driver configs (window 8192, worlds 8..256) all qualify."""
+    m = window // world
+    if m >= _LANES:
+        return m % _LANES == 0
+    return _LANES % m == 0 and m >= 8
+
+
 @functools.lru_cache(maxsize=None)
 def _build_amortized(n, window, world, body, order_windows, rounds,
-                     interpret, block_rows=_BLOCK_ROWS):
+                     interpret, block_rows=_BLOCK_ROWS_AMORTIZED):
     m = window // world
     rows_needed = math.ceil(body / _LANES)
     block_rows = max(8, min(block_rows, math.ceil(rows_needed / 8) * 8))
     tile = block_rows * _LANES
     padded = math.ceil(body / tile) * tile
     grid = (padded // tile,)
+    total_rows = padded // _LANES
+    # compact window-id layout per _expand_window_ids: one id per output
+    # row (m >= 128) or g = 128/m ids per output row (m < 128)
+    ku_cols = 1 if m >= _LANES else _LANES // m
     kernel = functools.partial(
         _amortized_kernel,
         window=window, world=world, m=m, rounds=rounds,
@@ -187,22 +242,28 @@ def _build_amortized(n, window, world, body, order_windows, rounds,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, 4), lambda i: (0, 0), memory_space=pltpu.SMEM),
-            pl.BlockSpec((block_rows, _LANES), lambda i: (i, 0)),
+            pl.BlockSpec((block_rows, ku_cols), lambda i: (i, 0)),
         ],
         out_specs=pl.BlockSpec((block_rows, _LANES), lambda i: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((padded // _LANES, _LANES), jnp.int32),
         cost_estimate=pl.CostEstimate(
             flops=padded * rounds * 15,
-            bytes_accessed=padded * 8,
+            bytes_accessed=padded * 4 + total_rows * ku_cols * 4,
             transcendentals=0,
         ),
         interpret=bool(interpret),
     )
 
-    def fn(scalars, kex):
-        kex_p = jnp.pad(kex, (0, padded - body)).reshape(padded // _LANES,
-                                                         _LANES)
-        return call(scalars, kex_p).reshape(-1)[:body]
+    def fn(scalars, ku):
+        # ku: compact per-WINDOW source ids, uint32[nw] — ~4/m bytes per
+        # output element instead of the per-element 4 bytes round 2 paid
+        if m >= _LANES:
+            ku = jnp.repeat(ku, m // _LANES)  # slot id of each output row
+        need = total_rows * ku_cols
+        ku_c = jnp.pad(ku, (0, need - ku.shape[0])).reshape(
+            total_rows, ku_cols
+        )
+        return call(scalars, ku_c).reshape(-1)[:body]
 
     return fn
 
@@ -218,12 +279,25 @@ def build_amortized_call(
     interpret: bool | None = None,
 ):
     """Kernel callable for the hoisted-outer-bijection path.  Takes the
-    uint32 (1, 4) scalar block and the per-element window-id vector
-    (uint32[nw*m], from xla._amortized_window_ids) and returns the BODY
-    lanes int32[nw*m]; the caller appends the tail/wrap lanes."""
+    uint32 (1, 4) scalar block and the COMPACT per-window source-id vector
+    (uint32[nw], from xla._window_order_ids) and returns the BODY lanes
+    int32[nw*m]; the caller appends the tail/wrap lanes (hence the
+    asserted, not consumed, ``num_samples``)."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     body = (n // window) * (window // world)
+    if num_samples < body:
+        raise ValueError(
+            f"num_samples ({num_samples}) < body lanes ({body}): the "
+            "amortized kernel emits all body lanes; callers slice/append "
+            "tails, never truncate"
+        )
+    if not compact_kex_applicable(window, world):
+        raise ValueError(
+            f"m={window // world} not expandable in-kernel (needs 128 | m, "
+            "or m | 128 with m >= 8); use the XLA amortized evaluator for "
+            "this config"
+        )
     return _build_amortized(
         int(n), int(window), int(world), int(body), bool(order_windows),
         int(rounds), bool(interpret),

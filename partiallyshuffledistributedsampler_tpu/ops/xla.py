@@ -40,17 +40,10 @@ def _amortized_applicable(n: int, window: int, world: int, shuffle: bool,
     )
 
 
-def _amortized_window_ids(sv, n: int, window: int, world: int,
-                          order_windows: bool, rounds: int):
-    """Per-element source-window ids for this rank's body lanes (uint32
-    [nw * m]), with the outer bijection evaluated once per window slot.
-
-    For strided partition with w = window/world aligned: element t of the
-    rank sits in output slot j = t // m, and its in-window offset is
-    r0 = rank + world*(t % m) — both exact for t < nw*m (no wrap: the
-    rank's body positions are all < body_len <= n).
-    """
-    m = window // world
+def _window_order_ids(sv, n: int, window: int, world: int,
+                      order_windows: bool, rounds: int):
+    """Compact per-window source ids (uint32[nw]) — the outer bijection
+    evaluated once per window slot — plus the epoch key."""
     nw = n // window
     ek = core.derive_epoch_key(jnp, (sv[0], sv[1]), sv[2])
     j = jnp.arange(nw, dtype=jnp.uint32)
@@ -58,6 +51,21 @@ def _amortized_window_ids(sv, n: int, window: int, world: int,
         ku = core.swap_or_not(jnp, j, nw, core.outer_key(jnp, ek), rounds)
     else:
         ku = j
+    return ku, ek
+
+
+def _amortized_window_ids(sv, n: int, window: int, world: int,
+                          order_windows: bool, rounds: int):
+    """Per-element source-window ids for this rank's body lanes (uint32
+    [nw * m]), expanded from the compact form.
+
+    For strided partition with w = window/world aligned: element t of the
+    rank sits in output slot j = t // m, and its in-window offset is
+    r0 = rank + world*(t % m) — both exact for t < nw*m (no wrap: the
+    rank's body positions are all < body_len <= n).
+    """
+    m = window // world
+    ku, ek = _window_order_ids(sv, n, window, world, order_windows, rounds)
     return jnp.repeat(ku, m), ek
 
 
@@ -91,23 +99,24 @@ def _epoch_indices_amortized(sv, n: int, window: int, world: int,
     return idx[:num_samples].astype(jnp.int32)
 
 
-def _resolve_use_pallas(use_pallas, n: int, amortized: bool) -> bool:
+def _resolve_use_pallas(use_pallas, n: int) -> bool:
     """'auto' (the user-surface default) picks the fused Pallas kernel
-    exactly where it is the measured winner: a real TPU backend, an
-    int32-range index space, and a config the hoisted-outer-bijection XLA
-    path does NOT cover.  When amortization applies, XLA wins because the
-    window-id stream fuses straight into the inner bijection, while the
-    kernel boundary forces it through HBM (slope-measured on the bench
-    device at 1e9/8192: amortized-xla 0.57 ms < amortized-pallas 0.92 ms <
-    general-pallas 2.7 ms < general-xla 4.6 ms per epoch of a 256-world).
-    Everywhere else — CPU test platform, n >= 2^31 — the XLA lowering is
-    both safer and faster than interpret-mode Pallas."""
+    wherever it is the measured winner: a real TPU backend with an
+    int32-range index space.  In the general regime the kernel wins
+    outright (slope-measured at 1e9/8192/world-256: general-pallas 2.7 ms
+    vs general-xla 4.6 ms).  In the amortized regime round 2's kernel lost
+    to XLA (0.92 vs 0.57 ms) because the per-element window-id stream
+    crossed the kernel boundary through HBM; round 3 moved the expansion
+    inside the kernel (compact per-window ids + in-kernel lane expansion,
+    pallas_kernel._expand_window_ids), after which the kernel edges out XLA
+    (0.50-0.53 vs 0.52-0.59 ms across repeated fits) — so 'auto' now says
+    yes here too, and _compiled_epoch_indices (the single gate) falls back
+    to the XLA amortized evaluator for the few configs the compact
+    expansion cannot cover.  On the CPU test platform and for n >= 2^31
+    the XLA lowering is both safer and faster than interpret-mode
+    Pallas."""
     if use_pallas == "auto":
-        return (
-            jax.default_backend() == "tpu"
-            and n <= 0x7FFFFFFF
-            and not amortized
-        )
+        return jax.default_backend() == "tpu" and n <= 0x7FFFFFFF
     return bool(use_pallas)
 
 
@@ -147,6 +156,14 @@ def _compiled_epoch_indices(
         n, window, world, shuffle, partition
     )
 
+    if use_pallas and amortized:
+        from . import pallas_kernel
+
+        if not pallas_kernel.compact_kex_applicable(window, world):
+            # an m that can't be expanded in-kernel: the XLA amortized
+            # evaluator is the measured next-best — fall back to it
+            use_pallas = False
+
     if use_pallas:
         from . import pallas_kernel
 
@@ -155,14 +172,15 @@ def _compiled_epoch_indices(
                 n, window, world, num_samples, order_windows=order_windows,
                 rounds=rounds,
             )
+            body_len = (n // window) * (window // world)
 
             def fn(sv):
-                kex, ek = _amortized_window_ids(
+                ku, ek = _window_order_ids(
                     sv, n, window, world, order_windows, rounds
                 )
-                body = call(sv.reshape(1, 4), kex)
-                if num_samples > kex.shape[0]:
-                    tpos = jnp.arange(kex.shape[0], num_samples,
+                body = call(sv.reshape(1, 4), ku)
+                if num_samples > body_len:
+                    tpos = jnp.arange(body_len, num_samples,
                                       dtype=jnp.uint32)
                     p = (sv[3] + jnp.uint32(world) * tpos) % jnp.uint32(n)
                     tail = core.windowed_perm(
@@ -329,7 +347,7 @@ def epoch_indices_jax(
     fn = _compiled_epoch_indices(
         int(n), int(window), int(world), bool(shuffle), bool(drop_last),
         bool(order_windows), str(partition), int(rounds),
-        _resolve_use_pallas(use_pallas, int(n), amortized),
+        _resolve_use_pallas(use_pallas, int(n)),
         bool(amortize),
     )
     if isinstance(rank, (int, np.integer)) and not (0 <= int(rank) < world):

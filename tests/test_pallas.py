@@ -57,3 +57,54 @@ def test_xla_entrypoint_dispatches_pallas():
     ref = cpu.epoch_indices_np(2048, 256, 1, 2, 0, 4)
     got = np.asarray(epoch_indices_jax(2048, 256, 1, 2, 0, 4, use_pallas=True))
     np.testing.assert_array_equal(got, ref)
+
+
+# ------------------------------------------- amortized compact-kex kernel
+def test_compact_kex_applicability_gate():
+    from partiallyshuffledistributedsampler_tpu.ops.pallas_kernel import (
+        build_amortized_call,
+        compact_kex_applicable,
+    )
+
+    assert compact_kex_applicable(8192, 256)   # m=32  (select path)
+    assert compact_kex_applicable(8192, 64)    # m=128 (broadcast)
+    assert compact_kex_applicable(8192, 8)     # m=1024 (broadcast)
+    assert not compact_kex_applicable(512, 256)   # m=2: g too long
+    assert not compact_kex_applicable(768, 4)     # m=192: 128 ∤ m
+    with pytest.raises(ValueError, match="expandable"):
+        build_amortized_call(10**9, 512, 256, 10**9 // 256, interpret=True)
+
+
+def test_amortized_call_asserts_num_samples_contract():
+    from partiallyshuffledistributedsampler_tpu.ops.pallas_kernel import (
+        build_amortized_call,
+    )
+
+    with pytest.raises(ValueError, match="body lanes"):
+        build_amortized_call(4096, 256, 8, 10, interpret=True)
+
+
+@pytest.mark.parametrize(
+    "n,window,world",
+    [
+        (4096, 256, 8),    # m=32: in-row select expansion
+        (8200, 128, 8),    # m=16: select expansion + tail lanes
+        (4096, 256, 2),    # m=128: row-broadcast expansion, q=1
+        (4100, 512, 2),    # m=256: row-broadcast, q=2, with tail
+    ],
+)
+def test_amortized_compact_expansion_bit_identical(n, window, world):
+    # the amortized kernel with IN-KERNEL window-id expansion (round 3's
+    # compact-kex design) against the numpy reference, both ranks' ends
+    from partiallyshuffledistributedsampler_tpu.ops.xla import (
+        epoch_indices_jax,
+    )
+
+    for rank in (0, world - 1):
+        for epoch in (0, 9):
+            ref = cpu.epoch_indices_np(n, window, 5, epoch, rank, world)
+            got = np.asarray(
+                epoch_indices_jax(n, window, 5, epoch, rank, world,
+                                  use_pallas=True)
+            )
+            np.testing.assert_array_equal(got, ref)
