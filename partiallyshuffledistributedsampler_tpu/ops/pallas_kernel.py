@@ -216,7 +216,7 @@ def compact_kex_applicable(window: int, world: int) -> bool:
     m = window // world
     if m >= _LANES:
         return m % _LANES == 0
-    return _LANES % m == 0 and m >= 8
+    return m >= 8 and _LANES % m == 0
 
 
 @functools.lru_cache(maxsize=None)

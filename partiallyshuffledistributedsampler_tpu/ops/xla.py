@@ -40,7 +40,7 @@ def _amortized_applicable(n: int, window: int, world: int, shuffle: bool,
     )
 
 
-def _window_order_ids(sv, n: int, window: int, world: int,
+def _window_order_ids(sv, n: int, window: int,
                       order_windows: bool, rounds: int):
     """Compact per-window source ids (uint32[nw]) — the outer bijection
     evaluated once per window slot — plus the epoch key."""
@@ -65,7 +65,7 @@ def _amortized_window_ids(sv, n: int, window: int, world: int,
     rank's body positions are all < body_len <= n).
     """
     m = window // world
-    ku, ek = _window_order_ids(sv, n, window, world, order_windows, rounds)
+    ku, ek = _window_order_ids(sv, n, window, order_windows, rounds)
     return jnp.repeat(ku, m), ek
 
 
@@ -156,14 +156,6 @@ def _compiled_epoch_indices(
         n, window, world, shuffle, partition
     )
 
-    if use_pallas and amortized:
-        from . import pallas_kernel
-
-        if not pallas_kernel.compact_kex_applicable(window, world):
-            # an m that can't be expanded in-kernel: the XLA amortized
-            # evaluator is the measured next-best — fall back to it
-            use_pallas = False
-
     if use_pallas:
         from . import pallas_kernel
 
@@ -176,7 +168,7 @@ def _compiled_epoch_indices(
 
             def fn(sv):
                 ku, ek = _window_order_ids(
-                    sv, n, window, world, order_windows, rounds
+                    sv, n, window, order_windows, rounds
                 )
                 body = call(sv.reshape(1, 4), ku)
                 if num_samples > body_len:
@@ -344,11 +336,25 @@ def epoch_indices_jax(
     amortized = bool(amortize) and _amortized_applicable(
         int(n), int(window), int(world), bool(shuffle), str(partition)
     )
+    resolved_pallas = _resolve_use_pallas(use_pallas, int(n))
+    eff_amortize = bool(amortize)
+    if resolved_pallas and amortized:
+        from .pallas_kernel import compact_kex_applicable
+
+        if not compact_kex_applicable(int(window), int(world)):
+            # the in-kernel window-id expansion can't cover this m: under
+            # 'auto' the XLA amortized evaluator is the measured next-best;
+            # an EXPLICIT use_pallas=True pin is honored with the general
+            # fused kernel (same value — all evaluators are bit-identical)
+            if use_pallas == "auto":
+                resolved_pallas = False
+            else:
+                eff_amortize = False
     fn = _compiled_epoch_indices(
         int(n), int(window), int(world), bool(shuffle), bool(drop_last),
         bool(order_windows), str(partition), int(rounds),
-        _resolve_use_pallas(use_pallas, int(n)),
-        bool(amortize),
+        resolved_pallas,
+        eff_amortize,
     )
     if isinstance(rank, (int, np.integer)) and not (0 <= int(rank) < world):
         # traced ranks legitimately can't be checked; concrete ones must be —

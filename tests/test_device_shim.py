@@ -1,0 +1,106 @@
+"""End-to-end torch-shim gate on the REAL device — VERDICT round 2, weak #5.
+
+The pytest process is pinned to the CPU platform (conftest.py), so the
+shim's xla backend pipeline — `set_epoch` async dispatch, the
+`copy_to_host_async` staging, the pending-buffer handoff and chunked
+streaming in `__iter__` (torch_shim.py) — normally never touches the
+machine's actual device in the suite; a device-specific transfer bug would
+ship green.  Same subprocess pattern as test_pallas_compiled.py: drop the
+platform override, construct the sampler with ``backend='xla'`` on the real
+TPU, and drive the full user flow (set_epoch -> iterate -> DataLoader ->
+checkpoint -> resume) against the cpu backend's answers.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_SCRIPT = r"""
+import sys
+import numpy as np
+import jax
+
+if jax.default_backend() != "tpu":
+    print("NO_TPU", jax.default_backend())
+    sys.exit(0)
+
+import torch
+from torch.utils.data import DataLoader, TensorDataset
+
+from partiallyshuffledistributedsampler_tpu import (
+    PartiallyShuffleDistributedSampler,
+)
+
+N, WINDOW, WORLD, RANK, SEED = 200_003, 512, 2, 1, 5
+ds = TensorDataset(torch.arange(N))
+
+
+def make(backend, seed=SEED):
+    return PartiallyShuffleDistributedSampler(
+        ds, num_replicas=WORLD, rank=RANK, window=WINDOW, seed=seed,
+        backend=backend,
+    )
+
+
+ref = make("cpu")
+dev = make("xla")
+
+# 1. plain iteration parity across epochs (exercises the async dispatch +
+#    chunked device->host streaming path end to end)
+for epoch in (0, 3):
+    ref.set_epoch(epoch)
+    dev.set_epoch(epoch)
+    if list(dev) != list(ref):
+        print("MISMATCH iterate epoch", epoch)
+        sys.exit(1)
+
+# 2. through a real DataLoader
+ref.set_epoch(1)
+dev.set_epoch(1)
+got = torch.cat([b[0] for b in DataLoader(ds, batch_size=1024, sampler=dev)])
+exp = torch.as_tensor(list(ref), dtype=got.dtype)
+if not torch.equal(got, exp):
+    print("MISMATCH dataloader")
+    sys.exit(1)
+
+# 3. checkpoint mid-epoch on the device backend, resume into a FRESH
+#    sampler (different constructor seed — state must fully override it)
+dev.set_epoch(2)
+it = iter(dev)
+head = [next(it) for _ in range(1234)]
+sd = dev.state_dict()
+res = make("xla", seed=0)
+res.load_state_dict(sd)
+tail = list(res)
+ref.set_epoch(2)
+if head + tail != list(ref):
+    print("MISMATCH resume: head", len(head), "tail", len(tail))
+    sys.exit(1)
+
+print("OK")
+"""
+
+
+def test_shim_xla_backend_end_to_end_on_real_device():
+    env = os.environ.copy()
+    env.pop("JAX_PLATFORMS", None)
+    env["XLA_FLAGS"] = " ".join(
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "host_platform_device_count" not in f
+    )
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    res = subprocess.run(
+        [sys.executable, "-c", _SCRIPT], env=env, cwd=repo,
+        capture_output=True, text=True, timeout=600,
+    )
+    out = res.stdout.strip().splitlines()
+    last = out[-1] if out else ""
+    if last.startswith("NO_TPU"):
+        pytest.skip(f"no TPU on this machine ({last}); shim e2e covered "
+                    "CPU-platform-only elsewhere")
+    assert res.returncode == 0 and last == "OK", (
+        f"device shim e2e failed:\nstdout: {res.stdout[-2000:]}\n"
+        f"stderr: {res.stderr[-2000:]}"
+    )

@@ -71,6 +71,7 @@ def test_compact_kex_applicability_gate():
     assert compact_kex_applicable(8192, 8)     # m=1024 (broadcast)
     assert not compact_kex_applicable(512, 256)   # m=2: g too long
     assert not compact_kex_applicable(768, 4)     # m=192: 128 ∤ m
+    assert not compact_kex_applicable(64, 128)    # world > window: m=0
     with pytest.raises(ValueError, match="expandable"):
         build_amortized_call(10**9, 512, 256, 10**9 // 256, interpret=True)
 
@@ -82,6 +83,20 @@ def test_amortized_call_asserts_num_samples_contract():
 
     with pytest.raises(ValueError, match="body lanes"):
         build_amortized_call(4096, 256, 8, 10, interpret=True)
+
+
+def test_explicit_pallas_pin_honored_when_compact_inapplicable():
+    # m=2 can't be expanded in-kernel; an explicit use_pallas=True must
+    # still run a Pallas kernel (the general one), bit-identically —
+    # never a silent demotion to the XLA evaluator
+    from partiallyshuffledistributedsampler_tpu.ops.xla import (
+        epoch_indices_jax,
+    )
+
+    ref = cpu.epoch_indices_np(2048, 512, 3, 1, 7, 256)
+    got = np.asarray(epoch_indices_jax(2048, 512, 3, 1, 7, 256,
+                                       use_pallas=True))
+    np.testing.assert_array_equal(got, ref)
 
 
 @pytest.mark.parametrize(
