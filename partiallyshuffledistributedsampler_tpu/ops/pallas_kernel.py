@@ -140,9 +140,13 @@ def _amortized_kernel(
     kex_ref,     # VMEM uint32: compact window ids — see _expand_window_ids
     out_ref,     # VMEM int32[block_rows, 128]
     *,
+    n: int,
     window: int,
     world: int,
     m: int,
+    body: int,
+    num_samples: int,
+    order_windows: bool,
     rounds: int,
     block_rows: int,
 ):
@@ -176,6 +180,21 @@ def _amortized_kernel(
         jnp, r0, window, kin, rounds, pair_key=core.inner_pair_key(jnp, ek)
     )
     out_ref[:, :] = (kex * jnp.uint32(window) + rho).astype(jnp.int32)
+
+    if num_samples > body:
+        # tail-window + wrap-padded lanes (t in [body, num_samples)) need
+        # the general law; they live in the trailing tile(s), so pl.when
+        # keeps every body-only grid step on the cheap path above
+        @pl.when(i >= jnp.uint32(body // tile))
+        def _tail():
+            p = (rank + jnp.uint32(world) * t) % jnp.uint32(n)
+            gen = core.windowed_perm(
+                jnp, p, n, window, ek, order_windows=order_windows,
+                rounds=rounds, pos_dtype=jnp.uint32,
+            )
+            out_ref[:, :] = jnp.where(
+                t >= jnp.uint32(body), gen.astype(jnp.int32), out_ref[:, :]
+            )
 
 
 def _expand_window_ids(ku, m: int, block_rows: int):
@@ -220,13 +239,13 @@ def compact_kex_applicable(window: int, world: int) -> bool:
 
 
 @functools.lru_cache(maxsize=None)
-def _build_amortized(n, window, world, body, order_windows, rounds,
-                     interpret, block_rows=_BLOCK_ROWS_AMORTIZED):
+def _build_amortized(n, window, world, body, num_samples, order_windows,
+                     rounds, interpret, block_rows=_BLOCK_ROWS_AMORTIZED):
     m = window // world
-    rows_needed = math.ceil(body / _LANES)
+    rows_needed = math.ceil(num_samples / _LANES)
     block_rows = max(8, min(block_rows, math.ceil(rows_needed / 8) * 8))
     tile = block_rows * _LANES
-    padded = math.ceil(body / tile) * tile
+    padded = math.ceil(num_samples / tile) * tile
     grid = (padded // tile,)
     total_rows = padded // _LANES
     # compact window-id layout per _expand_window_ids: one id per output
@@ -234,7 +253,8 @@ def _build_amortized(n, window, world, body, order_windows, rounds,
     ku_cols = 1 if m >= _LANES else _LANES // m
     kernel = functools.partial(
         _amortized_kernel,
-        window=window, world=world, m=m, rounds=rounds,
+        n=n, window=window, world=world, m=m, body=body,
+        num_samples=num_samples, order_windows=order_windows, rounds=rounds,
         block_rows=block_rows,
     )
     call = pl.pallas_call(
@@ -256,14 +276,16 @@ def _build_amortized(n, window, world, body, order_windows, rounds,
 
     def fn(scalars, ku):
         # ku: compact per-WINDOW source ids, uint32[nw] — ~4/m bytes per
-        # output element instead of the per-element 4 bytes round 2 paid
+        # output element instead of the per-element 4 bytes round 2 paid.
+        # Tail/wrap lanes are produced in-kernel (final tiles only), so the
+        # slice below is the ONLY post-kernel op — no concat copy.
         if m >= _LANES:
             ku = jnp.repeat(ku, m // _LANES)  # slot id of each output row
         need = total_rows * ku_cols
         ku_c = jnp.pad(ku, (0, need - ku.shape[0])).reshape(
             total_rows, ku_cols
         )
-        return call(scalars, ku_c).reshape(-1)[:body]
+        return call(scalars, ku_c).reshape(-1)[:num_samples]
 
     return fn
 
@@ -280,17 +302,17 @@ def build_amortized_call(
 ):
     """Kernel callable for the hoisted-outer-bijection path.  Takes the
     uint32 (1, 4) scalar block and the COMPACT per-window source-id vector
-    (uint32[nw], from xla._window_order_ids) and returns the BODY lanes
-    int32[nw*m]; the caller appends the tail/wrap lanes (hence the
-    asserted, not consumed, ``num_samples``)."""
+    (uint32[nw], from xla._window_order_ids) and returns the rank's FULL
+    int32[num_samples] — tail-window and wrap-padded lanes are computed
+    in-kernel by the trailing tile(s), so no post-kernel concat is needed."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     body = (n // window) * (window // world)
     if num_samples < body:
         raise ValueError(
             f"num_samples ({num_samples}) < body lanes ({body}): the "
-            "amortized kernel emits all body lanes; callers slice/append "
-            "tails, never truncate"
+            "amortized kernel emits all body lanes; callers slice, never "
+            "truncate"
         )
     if not compact_kex_applicable(window, world):
         raise ValueError(
@@ -299,8 +321,8 @@ def build_amortized_call(
             "this config"
         )
     return _build_amortized(
-        int(n), int(window), int(world), int(body), bool(order_windows),
-        int(rounds), bool(interpret),
+        int(n), int(window), int(world), int(body), int(num_samples),
+        bool(order_windows), int(rounds), bool(interpret),
     )
 
 

@@ -164,23 +164,14 @@ def _compiled_epoch_indices(
                 n, window, world, num_samples, order_windows=order_windows,
                 rounds=rounds,
             )
-            body_len = (n // window) * (window // world)
 
             def fn(sv):
-                ku, ek = _window_order_ids(
+                # tail/wrap lanes are produced in-kernel; the only XLA-side
+                # work is the tiny compact window-id vector (uint32[nw])
+                ku, _ = _window_order_ids(
                     sv, n, window, order_windows, rounds
                 )
-                body = call(sv.reshape(1, 4), ku)
-                if num_samples > body_len:
-                    tpos = jnp.arange(body_len, num_samples,
-                                      dtype=jnp.uint32)
-                    p = (sv[3] + jnp.uint32(world) * tpos) % jnp.uint32(n)
-                    tail = core.windowed_perm(
-                        jnp, p, n, window, ek, order_windows=order_windows,
-                        rounds=rounds, pos_dtype=jnp.uint32,
-                    ).astype(jnp.int32)
-                    body = jnp.concatenate([body, tail])
-                return body[:num_samples]
+                return call(sv.reshape(1, 4), ku)
         else:
             call = pallas_kernel.build_call(
                 n, window, world, shuffle=shuffle, drop_last=drop_last,

@@ -102,10 +102,16 @@ def test_explicit_pallas_pin_honored_when_compact_inapplicable():
 @pytest.mark.parametrize(
     "n,window,world",
     [
-        (4096, 256, 8),    # m=32: in-row select expansion
-        (8200, 128, 8),    # m=16: select expansion + tail lanes
-        (4096, 256, 2),    # m=128: row-broadcast expansion, q=1
-        (4100, 512, 2),    # m=256: row-broadcast, q=2, with tail
+        (4096, 256, 8),      # m=32: in-row select expansion
+        (8200, 128, 8),      # m=16: select expansion + tail lanes
+        (4096, 256, 2),      # m=128: row-broadcast expansion, q=1
+        (4100, 512, 2),      # m=256: row-broadcast, q=2, with tail
+        (70_000, 32768, 2),  # m=16384: tail starts exactly on a tile edge
+        (50_000, 16384, 2),  # m=8192: body=1.5 tiles — a tile mixes body
+                             #   and tail lanes (mid-tile straddle)
+        (900, 1024, 2),      # window > n: amortization is inapplicable
+                             #   (nw=0) so this pins the general-kernel
+                             #   routing for the degenerate config
     ],
 )
 def test_amortized_compact_expansion_bit_identical(n, window, world):
