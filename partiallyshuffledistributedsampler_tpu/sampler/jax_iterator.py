@@ -71,6 +71,7 @@ class DeviceEpochIterator:
             )
         self.prefetch_next_epoch = prefetch_next_epoch
         self._cache: dict[int, jax.Array] = {}
+        self._runners: dict = {}
 
     def _regen(self, epoch: int) -> jax.Array:
         return epoch_indices_jax(
@@ -84,14 +85,17 @@ class DeviceEpochIterator:
             arr = self._regen(epoch)
         return arr
 
+    def _prefetch(self, epoch: int) -> None:
+        # async dispatch — device works on it behind this epoch's steps
+        self._cache[epoch + 1] = self._regen(epoch + 1)
+        if len(self._cache) > 2:  # bound memory if epochs are skipped
+            for k in sorted(self._cache)[:-2]:
+                del self._cache[k]
+
     def epoch(self, epoch: int) -> Iterator[jax.Array]:
         idx = self.epoch_array(epoch)
         if self.prefetch_next_epoch:
-            # async dispatch — device works on it behind this epoch's steps
-            self._cache[epoch + 1] = self._regen(epoch + 1)
-            if len(self._cache) > 2:  # bound memory if epochs are skipped
-                for k in sorted(self._cache)[:-2]:
-                    del self._cache[k]
+            self._prefetch(epoch)
         for s in range(self.steps_per_epoch):
             start = s * self.batch
             size = min(self.batch, self.num_samples - start)
@@ -99,3 +103,59 @@ class DeviceEpochIterator:
                 yield jax.lax.dynamic_slice(idx, (start,), (self.batch,))
             else:
                 yield idx[start:start + size]
+
+    def run_epoch(self, epoch: int, step_fn, carry, *,
+                  steps: Optional[int] = None, collect: bool = False):
+        """Run an epoch's training steps in ONE compiled program.
+
+        ``lax.scan`` drives ``step_fn`` over the epoch's step windows with
+        the batch slice fused into the program, so a whole epoch costs a
+        single dispatch — no per-step Python or eager-slice overhead at
+        all (the ``epoch()`` iterator pays one eager dispatch per step,
+        which is µs on real hardware but is also simply unnecessary when
+        the loop body is jittable).
+
+        ``step_fn(carry, idx_batch) -> carry`` — or, with
+        ``collect=True``, ``-> (carry, y)``, and the stacked ``y``s are
+        returned alongside the final carry (the usual per-step-loss
+        pattern).  ``steps`` caps the step count; the default is every
+        WHOLE batch (a trailing partial batch can't share the scanned
+        program's shape — drive it through ``epoch()`` if it matters).
+        The compiled runner is cached per ``(step_fn, steps, collect)``,
+        keyed on the function OBJECT — pass the same function each epoch
+        to reuse it; the cache holds the 4 most recent runners, so a
+        fresh lambda per call recompiles every time.  Next-epoch prefetch
+        is dispatched before the scan, exactly like ``epoch()``.
+        """
+        arr = self.epoch_array(epoch)
+        if self.prefetch_next_epoch:
+            self._prefetch(epoch)
+        whole = self.num_samples // self.batch  # only whole batches scan
+        nsteps = whole if steps is None else int(steps)
+        if not 0 < nsteps <= whole:
+            raise ValueError(
+                f"steps={nsteps} not in [1, {whole}]"
+                " (only whole batches can be scanned)"
+            )
+        key = (step_fn, nsteps, bool(collect))
+        runner = self._runners.get(key)
+        if runner is None:
+            if len(self._runners) >= 4:  # bound: a fresh step_fn object per
+                # call would otherwise recompile AND retain forever
+                self._runners.pop(next(iter(self._runners)))
+            batch = self.batch
+
+            @jax.jit
+            def runner(carry, idx):
+                def body(c, s):
+                    b = jax.lax.dynamic_slice(idx, (s * batch,), (batch,))
+                    out = step_fn(c, b)
+                    return out if collect else (out, None)
+
+                c, ys = jax.lax.scan(
+                    body, carry, jnp.arange(nsteps, dtype=jnp.int32)
+                )
+                return (c, ys) if collect else c
+
+            self._runners[key] = runner
+        return runner(carry, arr)
