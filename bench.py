@@ -131,6 +131,24 @@ def main() -> None:
     import numpy as np
 
     kernel_256 = {}
+    metric_printed = False
+
+    def _print_metric():
+        # the driver parses the LAST stdout line; emit the headline as soon
+        # as the production evaluator is measured so a driver-side timeout
+        # partway through the secondary combos/stall tiers can't lose the
+        # round's number (there is still exactly one stdout line per run)
+        best = kernel_256.get("auto")
+        if best is None:
+            return False
+        print(json.dumps({
+            "metric": "epoch_index_regen_ms_1b_samples",
+            "value": round(best, 3),
+            "unit": "ms",
+            "vs_baseline": round(HOST_FULL_RANDPERM_MS / max(best, 1e-6), 1),
+        }), flush=True)
+        return True
+
     for label, kw in combos.items():
         try:
             t = {w: _anchored_ms_per_epoch(regen(w, **kw)) for w in FIT_WORLDS}
@@ -154,6 +172,8 @@ def main() -> None:
                 details[f"{label}_fit_warn"] = True
         except Exception as exc:  # pallas unavailable on some backends
             details[f"{label}_error"] = repr(exc)[:200]
+        if label == "auto":
+            metric_printed = _print_metric()
 
     # legacy round-1 comparable figures (same-algorithm pallas-vs-xla law:
     # the named native kernel must beat the equivalent XLA lowering)
@@ -186,17 +206,9 @@ def main() -> None:
         except Exception as exc:
             details["stall_error"] = repr(exc)[:200]
 
-    best = kernel_256.get("auto")
-    if best is None or not kernel_256:
-        print(json.dumps(details), file=sys.stderr)
-        raise SystemExit("no backend produced a timing")
     print(json.dumps(details), file=sys.stderr)
-    print(json.dumps({
-        "metric": "epoch_index_regen_ms_1b_samples",
-        "value": round(best, 3),
-        "unit": "ms",
-        "vs_baseline": round(HOST_FULL_RANDPERM_MS / max(best, 1e-6), 1),
-    }))
+    if not metric_printed:
+        raise SystemExit("no backend produced a timing")
 
 
 if __name__ == "__main__":

@@ -4,6 +4,7 @@ from .gpt import GPTConfig, MiniGPT, forward, init_params  # noqa: F401
 from .train import (  # noqa: F401
     create_sharded_state,
     demo_training_run,
+    make_epoch_runner,
     make_mesh,
     make_train_step,
 )

@@ -73,15 +73,11 @@ def create_sharded_state(cfg: GPTConfig, mesh: Mesh, seed: int = 0):
     return params, opt_state, tx
 
 
-def make_train_step(cfg: GPTConfig, tx, mesh: Mesh, batch_per_dp: int):
-    """Jitted full training step.
-
-    Signature: ``(params, opt_state, tokens, epoch_idx, step) ->
-    (params, opt_state, loss)`` where ``epoch_idx`` is the mesh-sharded
-    [dp, num_samples] index tensor from ``sharded_epoch_indices`` and
-    ``tokens`` the (replicated) token table [n, seq+1].  The batch gather
-    happens on device: dynamic-slice the step's index window, take rows.
-    """
+def _make_step_math(cfg: GPTConfig, tx, mesh: Mesh, batch_per_dp: int):
+    """The un-jitted step body shared by the per-step and per-epoch
+    entry points: dynamic-slice the step's [dp, batch_per_dp] index
+    window out of the mesh-sharded epoch tensor, gather token rows on
+    device, fwd/bwd/update."""
     dp = mesh.shape["dp"]
 
     def loss_fn(params, batch):
@@ -107,7 +103,49 @@ def make_train_step(cfg: GPTConfig, tx, mesh: Mesh, batch_per_dp: int):
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
 
-    return jax.jit(step_fn, donate_argnums=(0, 1))
+    return step_fn
+
+
+def make_train_step(cfg: GPTConfig, tx, mesh: Mesh, batch_per_dp: int):
+    """Jitted full training step.
+
+    Signature: ``(params, opt_state, tokens, epoch_idx, step) ->
+    (params, opt_state, loss)`` where ``epoch_idx`` is the mesh-sharded
+    [dp, num_samples] index tensor from ``sharded_epoch_indices`` and
+    ``tokens`` the (replicated) token table [n, seq+1].  The batch gather
+    happens on device: dynamic-slice the step's index window, take rows.
+    """
+    return jax.jit(
+        _make_step_math(cfg, tx, mesh, batch_per_dp), donate_argnums=(0, 1)
+    )
+
+
+def make_epoch_runner(cfg: GPTConfig, tx, mesh: Mesh, batch_per_dp: int,
+                      steps_per_epoch: int):
+    """Jitted full EPOCH: ``lax.scan`` over the train steps, so an entire
+    epoch of sharded steps — batch gathers, collectives, updates — is one
+    dispatch (the per-device analogue is DeviceEpochIterator.run_epoch).
+
+    Signature: ``(params, opt_state, tokens, epoch_idx) ->
+    (params, opt_state, losses[steps_per_epoch])``.
+    """
+    step_fn = _make_step_math(cfg, tx, mesh, batch_per_dp)
+
+    def epoch_fn(params, opt_state, tokens, epoch_idx):
+        def body(carry, s):
+            params, opt_state = carry
+            params, opt_state, loss = step_fn(
+                params, opt_state, tokens, epoch_idx, s
+            )
+            return (params, opt_state), loss
+
+        (params, opt_state), losses = jax.lax.scan(
+            body, (params, opt_state),
+            jnp.arange(steps_per_epoch, dtype=jnp.int32),
+        )
+        return params, opt_state, losses
+
+    return jax.jit(epoch_fn, donate_argnums=(0, 1))
 
 
 def demo_training_run(
@@ -120,27 +158,37 @@ def demo_training_run(
     steps_per_epoch: int = 2,
     epochs: int = 2,
     seed: int = 0,
+    scan_epochs: bool = False,
 ) -> list:
     """The minimum end-to-end slice (SURVEY.md §7 build order #3, scaled to
     the test mesh): synthetic token dataset -> per-epoch on-device regen with
-    ICI seed agreement -> sharded train steps.  Returns per-step losses."""
+    ICI seed agreement -> sharded train steps.  Returns per-step losses.
+    ``scan_epochs=True`` drives each epoch through ``make_epoch_runner``
+    (one dispatch per epoch) instead of a Python step loop."""
     cfg = cfg or GPTConfig()
     tokens = jax.random.randint(
         jax.random.PRNGKey(seed + 1), (n_samples, cfg.seq_len + 1), 0,
         cfg.vocab_size, dtype=jnp.int32,
     )
     params, opt_state, tx = create_sharded_state(cfg, mesh, seed)
-    step = make_train_step(cfg, tx, mesh, batch_per_dp)
     losses = []
+    if scan_epochs:
+        run = make_epoch_runner(cfg, tx, mesh, batch_per_dp, steps_per_epoch)
+    else:
+        step = make_train_step(cfg, tx, mesh, batch_per_dp)
     for epoch in range(epochs):
         # the set_epoch moment: one fused XLA program agrees on the seed over
         # ICI and emits every dp rank's shard in its own HBM
         idx = sharded_epoch_indices(
             mesh, n_samples, window, seed, epoch, axis="dp"
         )
-        for s in range(steps_per_epoch):
-            params, opt_state, loss = step(
-                params, opt_state, tokens, idx, jnp.int32(s)
-            )
-            losses.append(float(loss))
+        if scan_epochs:
+            params, opt_state, ls = run(params, opt_state, tokens, idx)
+            losses.extend(float(l) for l in np.asarray(ls))
+        else:
+            for s in range(steps_per_epoch):
+                params, opt_state, loss = step(
+                    params, opt_state, tokens, idx, jnp.int32(s)
+                )
+                losses.append(float(loss))
     return losses

@@ -138,10 +138,13 @@ class DeviceEpochIterator:
                 " (only whole batches can be scanned)"
             )
         key = (step_fn, nsteps, bool(collect))
-        runner = self._runners.get(key)
-        if runner is None:
+        runner = self._runners.pop(key, None)
+        if runner is not None:
+            self._runners[key] = runner  # re-insert: LRU recency refresh
+        else:
             if len(self._runners) >= 4:  # bound: a fresh step_fn object per
-                # call would otherwise recompile AND retain forever
+                # call would otherwise recompile AND retain forever; evict
+                # the least recently USED, never a hot runner
                 self._runners.pop(next(iter(self._runners)))
             batch = self.batch
 

@@ -60,6 +60,18 @@ def test_training_runs_and_losses_finite():
     assert all(np.isfinite(l) for l in losses)
 
 
+def test_scanned_epoch_runner_matches_step_loop():
+    # make_epoch_runner (lax.scan over sharded steps, one dispatch/epoch)
+    # must produce the same training trajectory as the per-step loop
+    mesh = make_mesh(8)
+    kw = dict(n_samples=64, window=16, batch_per_dp=2, steps_per_epoch=2,
+              epochs=2)
+    stepped = demo_training_run(mesh, TINY, **kw)
+    scanned = demo_training_run(mesh, TINY, scan_epochs=True, **kw)
+    assert len(scanned) == len(stepped) == 4
+    np.testing.assert_allclose(scanned, stepped, rtol=1e-5, atol=1e-6)
+
+
 def test_training_deterministic_across_meshes():
     # dp=4,tp=2 vs dp=2,tp=2: same data order per epoch (the sampler contract
     # holds per dp-world); losses differ because dp-world differs — but a

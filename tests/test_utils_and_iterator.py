@@ -174,11 +174,19 @@ def test_run_epoch_default_clamps_to_whole_batches():
     assert int(c) == 12 and ys.shape == (12,)
 
 
-def test_run_epoch_runner_cache_bounded():
+def test_run_epoch_runner_cache_bounded_and_lru():
     it = DeviceEpochIterator(n=256, window=16, batch=32, world=1)
-    for k in range(6):  # fresh lambda per call -> distinct cache keys
+    hot = lambda c, i: c + i.sum()
+    it.run_epoch(0, hot, jnp.int32(0))
+    hot_runner = it._runners[(hot, it.num_samples // it.batch, False)]
+    for k in range(5):  # fresh lambda per call -> distinct cache keys
         it.run_epoch(0, lambda c, i, _k=k: c, jnp.int32(0))
+        it.run_epoch(0, hot, jnp.int32(0))  # keep the hot runner recent
     assert len(it._runners) <= 4
+    # the hot step_fn was used every other call — eviction must spare it
+    assert it._runners.get(
+        (hot, it.num_samples // it.batch, False)
+    ) is hot_runner
 
 
 def test_batch_index_window_1d_and_2d():
