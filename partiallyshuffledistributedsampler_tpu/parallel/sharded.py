@@ -41,6 +41,11 @@ def _compiled_sharded(
     rounds: int,
 ):
     num_samples, _ = core.shard_sizes(n, world, drop_last)
+    from ..ops import xla as xla_ops
+
+    amortized = xla_ops._amortized_applicable(
+        n, window, world, shuffle, partition
+    )
 
     def per_device(local_triple):
         # local_triple: uint32[1, 3] — this device's (seed_lo, seed_hi, epoch)
@@ -50,11 +55,25 @@ def _compiled_sharded(
         # contributes zeros except rank 0, psum rides the interconnect.
         masked = jnp.where(rank == 0, mine, jnp.zeros_like(mine))
         agreed = jax.lax.psum(masked, axis)
-        idx = core.epoch_indices_generic(
-            jnp, n, window, (agreed[0], agreed[1]), agreed[2], rank, world,
-            shuffle=shuffle, drop_last=drop_last, order_windows=order_windows,
-            partition=partition, rounds=rounds,
-        )
+        if amortized:
+            # the hoisted-outer-bijection evaluator (pure jnp, so it fuses
+            # into this shard_map program like the general law does) — the
+            # measured ~10x win over per-element evaluation at production
+            # shapes; bit-identical by the parity suite
+            sv = jnp.stack([
+                agreed[0], agreed[1], agreed[2],
+                rank.astype(jnp.uint32),
+            ])
+            idx = xla_ops._epoch_indices_amortized(
+                sv, n, window, world, num_samples, order_windows, rounds
+            )
+        else:
+            idx = core.epoch_indices_generic(
+                jnp, n, window, (agreed[0], agreed[1]), agreed[2], rank,
+                world, shuffle=shuffle, drop_last=drop_last,
+                order_windows=order_windows, partition=partition,
+                rounds=rounds,
+            )
         return idx[None, :]
 
     from jax import shard_map
