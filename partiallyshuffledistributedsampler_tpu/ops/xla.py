@@ -151,14 +151,13 @@ def _compiled_epoch_indices(
     host->device transfer, which is the dominant per-call cost at sub-ms
     regen latencies (measurably so through the emulator tunnel)."""
     _require_x64_for_big_n(n)
-    num_samples, _ = core.shard_sizes(n, world, drop_last)
-    amortized = amortize and _amortized_applicable(
-        n, window, world, shuffle, partition
-    )
-
     if use_pallas:
         from . import pallas_kernel
 
+        num_samples, _ = core.shard_sizes(n, world, drop_last)
+        amortized = amortize and _amortized_applicable(
+            n, window, world, shuffle, partition
+        )
         if amortized:
             call = pallas_kernel.build_amortized_call(
                 n, window, world, num_samples, order_windows=order_windows,
@@ -181,7 +180,44 @@ def _compiled_epoch_indices(
 
             def fn(sv):
                 return call(sv.reshape(1, 4))
-    elif amortized:
+    else:
+        fn = build_evaluator(
+            n, window, world, shuffle=shuffle, drop_last=drop_last,
+            order_windows=order_windows, partition=partition, rounds=rounds,
+            amortize=amortize,
+        )
+
+    return jax.jit(fn)
+
+
+def build_evaluator(
+    n: int,
+    window: int,
+    world: int,
+    *,
+    shuffle: bool = True,
+    drop_last: bool = False,
+    order_windows: bool = True,
+    partition: str = "strided",
+    rounds: int = core.DEFAULT_ROUNDS,
+    amortize: bool = True,
+):
+    """The pure-jnp evaluator ``fn(sv) -> int32[num_samples]`` for a static
+    config, with ``sv = uint32[4] (seed_lo, seed_hi, epoch, rank)`` traced.
+
+    The single place that dispatches between the hoisted-outer-bijection
+    (amortized) form and the general per-element law — used both by the
+    jitted single-device executable above and by the mesh-sharded
+    ``shard_map`` program (parallel/sharded.py), which fuses it behind the
+    ICI seed-agreement collective.  Jit-compatible, composable under
+    ``shard_map``/``vmap``; no Pallas (kernels can't be assumed available
+    in every consumer context — the jitted path layers that on top).
+    """
+    _require_x64_for_big_n(n)
+    num_samples, _ = core.shard_sizes(n, world, drop_last)
+    if bool(amortize) and _amortized_applicable(
+        n, window, world, shuffle, partition
+    ):
         def fn(sv):
             return _epoch_indices_amortized(
                 sv, n, window, world, num_samples, order_windows, rounds
@@ -195,7 +231,7 @@ def _compiled_epoch_indices(
                 rounds=rounds,
             )
 
-    return jax.jit(fn)
+    return fn
 
 
 @functools.lru_cache(maxsize=None)

@@ -25,6 +25,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops import core
+from ..ops.xla import build_evaluator
 
 
 @functools.lru_cache(maxsize=None)
@@ -41,10 +42,13 @@ def _compiled_sharded(
     rounds: int,
 ):
     num_samples, _ = core.shard_sizes(n, world, drop_last)
-    from ..ops import xla as xla_ops
-
-    amortized = xla_ops._amortized_applicable(
-        n, window, world, shuffle, partition
+    # the shared pure-jnp evaluator (ops/xla.build_evaluator): amortized
+    # hoisted-outer-bijection form where applicable — the measured ~10x win
+    # over per-element evaluation at production shapes — general law
+    # otherwise; it fuses into this shard_map program either way
+    evaluator = build_evaluator(
+        n, window, world, shuffle=shuffle, drop_last=drop_last,
+        order_windows=order_windows, partition=partition, rounds=rounds,
     )
 
     def per_device(local_triple):
@@ -55,26 +59,10 @@ def _compiled_sharded(
         # contributes zeros except rank 0, psum rides the interconnect.
         masked = jnp.where(rank == 0, mine, jnp.zeros_like(mine))
         agreed = jax.lax.psum(masked, axis)
-        if amortized:
-            # the hoisted-outer-bijection evaluator (pure jnp, so it fuses
-            # into this shard_map program like the general law does) — the
-            # measured ~10x win over per-element evaluation at production
-            # shapes; bit-identical by the parity suite
-            sv = jnp.stack([
-                agreed[0], agreed[1], agreed[2],
-                rank.astype(jnp.uint32),
-            ])
-            idx = xla_ops._epoch_indices_amortized(
-                sv, n, window, world, num_samples, order_windows, rounds
-            )
-        else:
-            idx = core.epoch_indices_generic(
-                jnp, n, window, (agreed[0], agreed[1]), agreed[2], rank,
-                world, shuffle=shuffle, drop_last=drop_last,
-                order_windows=order_windows, partition=partition,
-                rounds=rounds,
-            )
-        return idx[None, :]
+        sv = jnp.stack([
+            agreed[0], agreed[1], agreed[2], rank.astype(jnp.uint32),
+        ])
+        return evaluator(sv)[None, :]
 
     from jax import shard_map
 

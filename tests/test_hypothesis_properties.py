@@ -1,0 +1,183 @@
+"""Hypothesis property suite — SURVEY.md §4's prescribed randomized
+invariant tests over ``(n, window, world, seed, epoch, ...)``.
+
+The fixed-grid tests elsewhere pin known-awkward shapes; this suite lets
+hypothesis hunt for unknown-awkward ones.  ``derandomize=True`` keeps CI
+deterministic (the corpus is derived from the property's source).
+
+Invariants (SURVEY §4):
+ 1. partition — ranks' shards are equal-length, in-range, and their union
+    is exactly the wrap-padded epoch stream;
+ 2. determinism — same config, same output;
+ 3. epoch variation — a different epoch permutes differently;
+ 4. windowing law — an emitted index's source window is the outer
+    bijection's image of its slot (locality: with order_windows=False every
+    body index stays inside its own window);
+ 5. degenerate configs are exercised by the same strategies (window=1,
+    window >= n, world=1, n % world != 0, drop_last both ways);
+ 6. random access (stream_indices_at) agrees with the materialized epoch;
+ 8. cpu <-> xla bit-identity (smaller space: each distinct config is a
+    fresh XLA compile).
+"""
+
+import numpy as np
+import pytest
+from hypothesis import HealthCheck, assume, given, settings
+from hypothesis import strategies as st
+
+from partiallyshuffledistributedsampler_tpu.ops import core, cpu
+
+SETTINGS = dict(
+    deadline=None,
+    derandomize=True,
+    suppress_health_check=[HealthCheck.too_slow],
+)
+
+CONFIGS = st.fixed_dictionaries(dict(
+    n=st.integers(1, 5000),
+    window=st.integers(1, 600),
+    world=st.integers(1, 9),
+    seed=st.integers(0, 2**63 - 1),
+    epoch=st.integers(0, 1000),
+    drop_last=st.booleans(),
+    order_windows=st.booleans(),
+    partition=st.sampled_from(["strided", "blocked"]),
+))
+
+
+def _ranks(cfg):
+    return [
+        cpu.epoch_indices_np(
+            cfg["n"], cfg["window"], cfg["seed"], cfg["epoch"], r,
+            cfg["world"], drop_last=cfg["drop_last"],
+            order_windows=cfg["order_windows"], partition=cfg["partition"],
+        )
+        for r in range(cfg["world"])
+    ]
+
+
+@settings(max_examples=120, **SETTINGS)
+@given(cfg=CONFIGS)
+def test_partition_union_and_lengths(cfg):
+    n, world = cfg["n"], cfg["world"]
+    num_samples, total = core.shard_sizes(n, world, cfg["drop_last"])
+    outs = _ranks(cfg)
+    for o in outs:
+        assert len(o) == num_samples
+        if num_samples:
+            assert o.min() >= 0 and o.max() < n
+    # union across ranks == the wrap-padded epoch stream as a multiset:
+    # value f(q) appears once per stream position p < total with p % n == q
+    if num_samples == 0:
+        return
+    counts = np.bincount(np.concatenate(outs), minlength=n)
+    f = cpu.stream_indices_at_np(
+        np.arange(min(n, total)), n, cfg["window"], cfg["seed"],
+        cfg["epoch"], order_windows=cfg["order_windows"],
+    )
+    # the first min(n, total) stream entries are distinct (f restricted to
+    # one wrap is injective — the permutation law is a bijection)
+    assert len(np.unique(f)) == len(f)
+    expected = np.zeros(n, dtype=np.int64)
+    q = np.arange(min(n, total))
+    expected[f[q]] = total // n + (q < total % n) if total >= n else 1
+    np.testing.assert_array_equal(counts, expected)
+
+
+@settings(max_examples=60, **SETTINGS)
+@given(cfg=CONFIGS)
+def test_determinism_and_random_access(cfg):
+    outs = _ranks(cfg)
+    again = _ranks(cfg)
+    for a, b in zip(outs, again):
+        np.testing.assert_array_equal(a, b)
+    # invariant 6: random access reproduces the materialized stream
+    num_samples, total = core.shard_sizes(
+        cfg["n"], cfg["world"], cfg["drop_last"]
+    )
+    if num_samples == 0 or cfg["partition"] != "strided":
+        return
+    r = cfg["world"] - 1
+    pos = (r + cfg["world"] * np.arange(num_samples)) % cfg["n"]
+    via_stream = cpu.stream_indices_at_np(
+        pos, cfg["n"], cfg["window"], cfg["seed"], cfg["epoch"],
+        order_windows=cfg["order_windows"],
+    )
+    np.testing.assert_array_equal(outs[r], via_stream)
+
+
+@settings(max_examples=60, **SETTINGS)
+@given(cfg=CONFIGS)
+def test_epoch_variation(cfg):
+    n, w = cfg["n"], cfg["window"]
+    # shuffling must be non-degenerate for epochs to differ: some window
+    # has >= 2 elements, or >= 2 whole windows get reordered
+    assume(n >= 16)
+    assume(min(w, n) >= 2 or (cfg["order_windows"] and n // w >= 2))
+    f0 = cpu.full_epoch_stream_np(
+        n, w, cfg["seed"], cfg["epoch"], order_windows=cfg["order_windows"]
+    )
+    f1 = cpu.full_epoch_stream_np(
+        n, w, cfg["seed"], cfg["epoch"] + 1,
+        order_windows=cfg["order_windows"],
+    )
+    assert not np.array_equal(f0, f1)
+
+
+@settings(max_examples=80, **SETTINGS)
+@given(cfg=CONFIGS)
+def test_windowing_law(cfg):
+    """Invariant 4: stream slot k's indices come from exactly one source
+    window — the outer bijection's image — and with order_windows=False
+    every body index stays inside its own window."""
+    n, w = cfg["n"], cfg["window"]
+    assume(n >= w)  # at least one whole window
+    f = cpu.full_epoch_stream_np(
+        n, w, cfg["seed"], cfg["epoch"], order_windows=cfg["order_windows"]
+    )
+    nw = n // w
+    body = nw * w
+    slots = np.arange(body) // w
+    src = f[:body] // w
+    # within a slot, all indices share one source window
+    for k in range(nw):
+        uniq = np.unique(src[slots == k])
+        assert len(uniq) == 1
+        if not cfg["order_windows"]:
+            assert uniq[0] == k
+    # and the slot->source map is a bijection over the whole windows
+    slot_src = src[::w][:nw]
+    assert len(np.unique(slot_src)) == nw
+
+
+@settings(max_examples=25, **SETTINGS)
+@given(cfg=st.fixed_dictionaries(dict(
+    n=st.integers(1, 900),
+    window=st.integers(1, 200),
+    world=st.integers(1, 5),
+    seed=st.integers(0, 2**63 - 1),
+    epoch=st.integers(0, 50),
+    drop_last=st.booleans(),
+    order_windows=st.booleans(),
+    partition=st.sampled_from(["strided", "blocked"]),
+)))
+def test_cpu_xla_parity(cfg):
+    """Invariant 8 under hypothesis: every generated config compiles its own
+    XLA executable, so the space is kept smaller than the host-only tests."""
+    from partiallyshuffledistributedsampler_tpu.ops.xla import (
+        epoch_indices_jax,
+    )
+
+    rank = cfg["world"] - 1
+    ref = cpu.epoch_indices_np(
+        cfg["n"], cfg["window"], cfg["seed"], cfg["epoch"], rank,
+        cfg["world"], drop_last=cfg["drop_last"],
+        order_windows=cfg["order_windows"], partition=cfg["partition"],
+    )
+    got = np.asarray(epoch_indices_jax(
+        cfg["n"], cfg["window"], cfg["seed"], cfg["epoch"], rank,
+        cfg["world"], drop_last=cfg["drop_last"],
+        order_windows=cfg["order_windows"], partition=cfg["partition"],
+    ))
+    assert got.dtype == ref.dtype
+    np.testing.assert_array_equal(got, ref)
