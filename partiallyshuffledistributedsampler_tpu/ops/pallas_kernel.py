@@ -32,6 +32,29 @@ from jax.experimental.pallas import tpu as pltpu
 from . import core
 
 _LANES = 128
+
+
+def _require_mosaic_compilable(interpret: bool) -> None:
+    """Compiled Mosaic is unavailable under jax_enable_x64 on this
+    toolchain: with x64 on, jax emits i64-typed scalar helper signatures
+    (e.g. divmod) that the kernel compiler fails to legalize
+    ('func.return (i64, i64)').  The interpreter is unaffected.  Raise a
+    named error instead of surfacing an opaque INTERNAL from the compile;
+    'auto' routing avoids this path under x64 (xla._resolve_use_pallas)."""
+    if not interpret and jax.config.read("jax_enable_x64"):
+        raise ValueError(
+            "pallas TPU kernels cannot compile under jax_enable_x64 on "
+            "this toolchain; use use_pallas=False (the XLA evaluator) or "
+            "'auto', which selects it automatically in x64 processes"
+        )
+
+
+def _require_int32_index_space(n: int) -> None:
+    if n > 0x7FFFFFFF:
+        raise ValueError(
+            "pallas path supports n <= int32 max; use the XLA backend with "
+            "enable_big_index_space() for larger index spaces"
+        )
 #: rows of 128 lanes each grid program computes.  (8, 128) is the VPU's
 #: native register shape but makes each program trivially small (1,024
 #: elements -> thousands of grid steps whose dispatch overhead dominates).
@@ -307,6 +330,8 @@ def build_amortized_call(
     in-kernel by the trailing tile(s), so no post-kernel concat is needed."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    _require_mosaic_compilable(interpret)
+    _require_int32_index_space(n)
     body = (n // window) * (window // world)
     if num_samples < body:
         raise ValueError(
@@ -345,11 +370,8 @@ def build_call(
     the CPU test platform)."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    if n > 0x7FFFFFFF:
-        raise ValueError(
-            "pallas path supports n <= int32 max; use the XLA backend with "
-            "enable_big_index_space() for larger index spaces"
-        )
+    _require_mosaic_compilable(interpret)
+    _require_int32_index_space(n)
     if partition not in ("strided", "blocked"):
         raise ValueError(f"partition must be 'strided' or 'blocked', got {partition!r}")
     num_samples, _ = core.shard_sizes(n, world, drop_last)

@@ -30,13 +30,27 @@ def _amortized_applicable(n: int, window: int, world: int, shuffle: bool,
     swap-or-not runs once per *window* instead of once per *element* — a
     ~2x cut in rounds evaluated.  Pure common-subexpression elimination:
     bit-identical to the SPEC.md law by algebra, asserted by parity tests.
+
+    For n >= 2^31 (the 10B-index stress regime) the evaluation stays
+    almost entirely in uint32 — window ids (< n/window), in-window offsets
+    (< window) and per-rank stream offsets (< ceil(n/world)) all fit — and
+    only the final ``kex * window + rho`` combine widens to uint64, so
+    amortization applies there too as long as each of those stays in
+    uint32-safe range.
     """
-    return (
+    if not (
         shuffle
         and partition == "strided"
-        and n <= 0x7FFFFFFF
         and window % world == 0
         and n // window >= 1
+    ):
+        return False
+    if n <= 0x7FFFFFFF:
+        return True
+    return (
+        n // window <= 0x7FFFFFFF
+        and window <= 0x7FFFFFFF
+        and -(-n // world) <= 0x7FFFFFFF
     )
 
 
@@ -73,10 +87,16 @@ def _epoch_indices_amortized(sv, n: int, window: int, world: int,
                              num_samples: int, order_windows: bool,
                              rounds: int):
     """Rank's epoch indices via the hoisted-outer-bijection evaluation
-    (jnp; jit-compatible).  Same value as epoch_indices_generic."""
+    (jnp; jit-compatible).  Same value as epoch_indices_generic.
+
+    For n >= 2^31 the bijections still run in uint32 (the applicability
+    gate bounds every intermediate); only the final combine and the tail
+    stream positions widen to uint64, and the output is int64 to match
+    the generic big-n convention."""
     m = window // world
     nw = n // window
     body = nw * m  # this rank's body sample count
+    big = n > 0x7FFFFFFF
     kex, ek = _amortized_window_ids(sv, n, window, world, order_windows, rounds)
     rank = sv[3]
     t = jnp.arange(body, dtype=jnp.uint32)
@@ -85,18 +105,22 @@ def _epoch_indices_amortized(sv, n: int, window: int, world: int,
     rho = core.swap_or_not(
         jnp, r0, window, kin, rounds, pair_key=core.inner_pair_key(jnp, ek)
     )
-    idx = kex * jnp.uint32(window) + rho
+    if big:
+        idx = kex.astype(jnp.uint64) * jnp.uint64(window) + rho
+    else:
+        idx = kex * jnp.uint32(window) + rho
     if num_samples > body:
         # tail-window + wrap-padded lanes: the general law on a tiny
         # static slice (at most m + ceil(tail/world) elements)
-        tpos = jnp.arange(body, num_samples, dtype=jnp.uint32)
-        p = (rank + jnp.uint32(world) * tpos) % jnp.uint32(n)
+        pos_dtype = jnp.uint64 if big else jnp.uint32
+        tpos = jnp.arange(body, num_samples, dtype=pos_dtype)
+        p = (rank.astype(pos_dtype) + pos_dtype(world) * tpos) % pos_dtype(n)
         tail = core.windowed_perm(
             jnp, p, n, window, ek, order_windows=order_windows,
-            rounds=rounds, pos_dtype=jnp.uint32,
+            rounds=rounds, pos_dtype=pos_dtype,
         )
         idx = jnp.concatenate([idx, tail])
-    return idx[:num_samples].astype(jnp.int32)
+    return idx[:num_samples].astype(jnp.int64 if big else jnp.int32)
 
 
 def _resolve_use_pallas(use_pallas, n: int) -> bool:
@@ -114,9 +138,16 @@ def _resolve_use_pallas(use_pallas, n: int) -> bool:
     to the XLA amortized evaluator for the few configs the compact
     expansion cannot cover.  On the CPU test platform and for n >= 2^31
     the XLA lowering is both safer and faster than interpret-mode
-    Pallas."""
+    Pallas.  Under ``jax_enable_x64`` Mosaic compilation is unavailable
+    on this toolchain (jax emits i64 helper signatures the kernel
+    compiler cannot legalize), so 'auto' falls back to XLA there — an
+    x64 process mixing 10B-index and small-n samplers keeps working."""
     if use_pallas == "auto":
-        return jax.default_backend() == "tpu" and n <= 0x7FFFFFFF
+        return (
+            jax.default_backend() == "tpu"
+            and n <= 0x7FFFFFFF
+            and not jax.config.read("jax_enable_x64")
+        )
     return bool(use_pallas)
 
 

@@ -64,6 +64,37 @@ def test_jax_x64_parity_subprocess():
             got = np.asarray(psds.epoch_indices_jax(n, w, 42, epoch, rank, world))
             assert got.dtype == np.int64, got.dtype
             np.testing.assert_array_equal(got, ref)
+        # the big-n AMORTIZED path (window % world == 0): prove the gate is
+        # on for this config, then check bit-parity vs the numpy reference
+        from partiallyshuffledistributedsampler_tpu.ops import xla as x
+        n2, w2, world2 = 10_000_000_000, 8192, 4096
+        assert x._amortized_applicable(n2, w2, world2, True, "strided")
+        for rank in (0, 4095):
+            ref = cpu.epoch_indices_np(n2, w2, 11, 3, rank, world2)
+            got = np.asarray(psds.epoch_indices_jax(n2, w2, 11, 3, rank, world2))
+            assert got.dtype == np.int64
+            np.testing.assert_array_equal(got, ref)
+        # x64 routing: 'auto' must not touch compiled Mosaic (which can't
+        # legalize under x64 on this toolchain) even for small n — force
+        # the backend check to look like TPU so the x64 condition itself
+        # is what's being tested (on this CPU platform it'd be vacuous)
+        import jax as _jax
+        _orig = _jax.default_backend
+        _jax.default_backend = lambda: "tpu"
+        try:
+            assert not x._resolve_use_pallas("auto", 1000)
+        finally:
+            _jax.default_backend = _orig
+        small = np.asarray(psds.epoch_indices_jax(50_000, 512, 1, 0, 0, 2))
+        np.testing.assert_array_equal(
+            small, cpu.epoch_indices_np(50_000, 512, 1, 0, 0, 2))
+        # ...and an explicit compiled-kernel pin raises a NAMED error
+        from partiallyshuffledistributedsampler_tpu.ops import pallas_kernel
+        try:
+            pallas_kernel.build_call(1000, 64, 2, interpret=False)
+            raise SystemExit("missing x64 pallas error")
+        except ValueError as e:
+            assert "x64" in str(e)
         print("X64_PARITY_OK")
     """)
     res = subprocess.run(
