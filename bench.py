@@ -50,19 +50,26 @@ PIPELINE = 8
 HOST_FULL_RANDPERM_MS = 94_200.0  # torch.randperm(1e9), BASELINE.md
 
 
-def _anchored_ms_per_epoch(fn):
-    """Lower-quartile per-epoch wall time with forced completion."""
+def _anchored_ms_per_epoch(fn, reps=None, pipeline=None):
+    """Lower-quartile per-epoch wall time with forced completion.
+
+    The single implementation of the round-2 measurement discipline —
+    benchmarks/sweep.py imports it too, so the completion/queue-order
+    assumptions live in exactly one place.  ``reps``/``pipeline`` default
+    to this module's (smoke-adjustable) globals."""
     import numpy as np
 
+    reps = REPS if reps is None else reps
+    pipeline = PIPELINE if pipeline is None else pipeline
     a = fn(0)
     a.block_until_ready()
     np.asarray(a[:8])  # warm the compile AND the anchor program
     times = []
-    for r in range(REPS):
+    for r in range(reps):
         t0 = time.perf_counter()
-        arrs = [fn(1 + r * PIPELINE + k) for k in range(PIPELINE)]
+        arrs = [fn(1 + r * pipeline + k) for k in range(pipeline)]
         np.asarray(arrs[-1][:8])  # queue order == completion order
-        times.append((time.perf_counter() - t0) * 1e3 / PIPELINE)
+        times.append((time.perf_counter() - t0) * 1e3 / pipeline)
     times.sort()
     return times[len(times) // 4]
 

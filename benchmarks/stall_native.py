@@ -1,6 +1,7 @@
 """Driver metric #2 — data-pipeline stall %, measured credibly.
 
-The round-2 harness (stall_bench.py) reported raw StallProbe fractions that
+The round-2 harness (stall_bench.py, since removed) reported raw
+StallProbe fractions that
 BASELINE.md itself conceded were 70-90 % DataLoader tensor-collation and
 emulator-tunnel noise in *every* backend — useless for attributing cost to
 the sampler.  This harness replaces it with a noise-subtracted design, in

@@ -4,6 +4,12 @@ Writes JSON lines to stdout — one per (backend, n) — so results can be
 appended next to the BASELINE.md table.  Run on the default device:
 
     python benchmarks/sweep.py [--quick]
+
+Device rows report WALL time per epoch with forced completion; on this
+rig that includes the ~13-40 ms per-execution emulator floor, so device
+walls look flat across n and can trail the host backends at small n.
+Kernel-attributable time is bench.py's job (the 3-anchor fit); this sweep
+is for scaling shape and host-backend crossovers.
 """
 
 from __future__ import annotations
@@ -19,9 +25,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 WINDOW = 8192
 WORLD = 256
 REPS = 8
+PIPELINE = 4
 
 
 def _steady_ms(fn) -> float:
+    """Host-backend timing: the call returns a completed numpy array."""
     fn(0)
     times = []
     for e in range(1, REPS + 1):
@@ -30,6 +38,15 @@ def _steady_ms(fn) -> float:
         times.append((time.perf_counter() - t0) * 1e3)
     times.sort()
     return times[len(times) // 4]
+
+
+def _steady_ms_device(fn) -> float:
+    """Device-backend timing: bench.py's forced-completion discipline (one
+    shared implementation — this rig's emulated device acks
+    block_until_ready without completing; BASELINE.md methodology)."""
+    from bench import _anchored_ms_per_epoch
+
+    return _anchored_ms_per_epoch(fn, reps=REPS, pipeline=PIPELINE)
 
 
 def main() -> None:
@@ -52,14 +69,18 @@ def main() -> None:
     scales = [10**6, 10**7, 10**8, 10**9]
     for n in scales:
         w = min(WINDOW, n)
-        backends = {
+        device_backends = {
+            "auto": lambda e, n=n, w=w: epoch_indices_jax(
+                n, w, 0, e, 0, WORLD
+            ),
             "xla": lambda e, n=n, w=w: epoch_indices_jax(
+                n, w, 0, e, 0, WORLD, use_pallas=False
+            ),
+            "pallas_general": lambda e, n=n, w=w: epoch_indices_pallas(
                 n, w, 0, e, 0, WORLD
-            ).block_until_ready(),
-            "pallas": lambda e, n=n, w=w: epoch_indices_pallas(
-                n, w, 0, e, 0, WORLD
-            ).block_until_ready(),
+            ),
         }
+        backends = {}
         host_ok = args.quick is False or n <= 10**8
         if host_ok:
             backends["numpy"] = lambda e, n=n, w=w: cpu.epoch_indices_np(
@@ -69,17 +90,19 @@ def main() -> None:
                 backends["native"] = lambda e, n=n, w=w: native.epoch_indices_native(
                     n, w, 0, e, 0, WORLD
                 )
-        for name, fn in backends.items():
-            try:
-                ms = _steady_ms(fn)
-                print(json.dumps({
-                    "backend": name, "n": n, "window": w, "world": WORLD,
-                    "per_epoch_ms": round(ms, 3),
-                }), flush=True)
-            except Exception as exc:
-                print(json.dumps({
-                    "backend": name, "n": n, "error": repr(exc)[:150]
-                }), flush=True)
+        for group, timer in ((device_backends, _steady_ms_device),
+                             (backends, _steady_ms)):
+            for name, fn in group.items():
+                try:
+                    ms = timer(fn)
+                    print(json.dumps({
+                        "backend": name, "n": n, "window": w, "world": WORLD,
+                        "per_epoch_ms": round(ms, 3),
+                    }), flush=True)
+                except Exception as exc:
+                    print(json.dumps({
+                        "backend": name, "n": n, "error": repr(exc)[:150]
+                    }), flush=True)
 
 
 if __name__ == "__main__":
