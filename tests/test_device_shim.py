@@ -79,6 +79,28 @@ if head + tail != list(ref):
     print("MISMATCH resume: head", len(head), "tail", len(tail))
     sys.exit(1)
 
+# 4. elastic reshard on the device backend: the jitted remainder-epoch
+#    executable (elastic_indices_jax) runs on the actual device here, and
+#    must match the cpu backend's remainder bit-for-bit for every new
+#    rank (the exactly-once LAWS are pinned by the CPU suite; this gates
+#    the device executable against that reference).
+dev2 = make("xla")
+dev2.set_epoch(4)
+it2 = iter(dev2)
+for _ in range(777):
+    next(it2)
+sd2 = dev2.state_dict()
+for r in range(3):
+    es_dev = PartiallyShuffleDistributedSampler.reshard_from_state_dict(
+        sd2, 3, r, dataset=ds, backend="xla"
+    )
+    es_cpu = PartiallyShuffleDistributedSampler.reshard_from_state_dict(
+        sd2, 3, r, dataset=ds, backend="cpu"
+    )
+    if list(es_dev) != list(es_cpu):
+        print("MISMATCH elastic device-vs-cpu, new rank", r)
+        sys.exit(1)
+
 print("OK")
 """
 
