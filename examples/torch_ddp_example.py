@@ -63,9 +63,15 @@ def run_rank(rank: int, ddp: bool = False) -> None:
         for x, y in probe:
             loss = torch.nn.functional.cross_entropy(model(x), y)
             opt.zero_grad(); loss.backward(); opt.step()
+        # raw_wait_pct is the UN-attributed StallProbe reading: it counts
+        # DataLoader tensor collation and (on emulated rigs) transfer-tunnel
+        # latency as "wait" — the sampler-attributable stall is what
+        # benchmarks/stall_native.py measures by subtraction (~0 for this
+        # backend at real epoch lengths)
         print(
             f"rank {rank} epoch {epoch}: {time.perf_counter()-t0:.2f}s, "
-            f"loss {loss.item():.3f}, stall {probe.report()['stall_pct']}%, "
+            f"loss {loss.item():.3f}, "
+            f"raw_wait {probe.report()['stall_pct']}%, "
             f"regen {sampler.regen_timer.last_ms:.2f} ms "
             f"[backend={sampler.backend}]"
         )

@@ -15,7 +15,7 @@ import jax
 import jax.numpy as jnp
 
 from ..ops import core
-from ..ops.xla import epoch_indices_jax
+from ..ops.xla import build_evaluator, epoch_indices_jax
 
 
 def batch_index_window(epoch_idx: jax.Array, step, batch: int) -> jax.Array:
@@ -55,6 +55,8 @@ class DeviceEpochIterator:
         prefetch_next_epoch: bool = True,
         **kwargs,
     ) -> None:
+        if not 0 <= rank < world:
+            raise ValueError(f"rank must be in [0, {world}), got {rank}")
         self.n, self.window, self.batch = n, window, batch
         self.seed, self.rank, self.world = seed, rank, world
         self.kwargs = kwargs
@@ -104,6 +106,33 @@ class DeviceEpochIterator:
             else:
                 yield idx[start:start + size]
 
+    def _cached_runner(self, key, build):
+        """LRU (bound 4) over compiled runners: refresh recency on hit,
+        evict the least recently USED on miss — a hot step_fn must never
+        be evicted and silently recompiled."""
+        runner = self._runners.pop(key, None)
+        if runner is None:
+            if len(self._runners) >= 4:
+                self._runners.pop(next(iter(self._runners)))
+            runner = build()
+        self._runners[key] = runner
+        return runner
+
+    def _step_scan_body(self, step_fn, collect: bool):
+        """The shared inner scan body: slice step s's batch out of a
+        device-resident epoch index tensor, run step_fn."""
+        batch = self.batch
+
+        def over(idx):
+            def body(c, s):
+                b = jax.lax.dynamic_slice(idx, (s * batch,), (batch,))
+                out = step_fn(c, b)
+                return out if collect else (out, None)
+
+            return body
+
+        return over
+
     def run_epoch(self, epoch: int, step_fn, carry, *,
                   steps: Optional[int] = None, collect: bool = False):
         """Run an epoch's training steps in ONE compiled program.
@@ -137,28 +166,80 @@ class DeviceEpochIterator:
                 f"steps={nsteps} not in [1, {whole}]"
                 " (only whole batches can be scanned)"
             )
-        key = (step_fn, nsteps, bool(collect))
-        runner = self._runners.pop(key, None)
-        if runner is not None:
-            self._runners[key] = runner  # re-insert: LRU recency refresh
-        else:
-            if len(self._runners) >= 4:  # bound: a fresh step_fn object per
-                # call would otherwise recompile AND retain forever; evict
-                # the least recently USED, never a hot runner
-                self._runners.pop(next(iter(self._runners)))
-            batch = self.batch
+        def build():
+            over = self._step_scan_body(step_fn, collect)
 
             @jax.jit
             def runner(carry, idx):
-                def body(c, s):
-                    b = jax.lax.dynamic_slice(idx, (s * batch,), (batch,))
-                    out = step_fn(c, b)
-                    return out if collect else (out, None)
-
                 c, ys = jax.lax.scan(
-                    body, carry, jnp.arange(nsteps, dtype=jnp.int32)
+                    over(idx), carry, jnp.arange(nsteps, dtype=jnp.int32)
                 )
                 return (c, ys) if collect else c
 
-            self._runners[key] = runner
+            return runner
+
+        runner = self._cached_runner((step_fn, nsteps, bool(collect)), build)
         return runner(carry, arr)
+
+    def run_epochs(self, first_epoch: int, n_epochs: int, step_fn, carry,
+                   *, collect: bool = False):
+        """Run ``n_epochs`` WHOLE epochs as one compiled program.
+
+        The permutation is a pure function of the traced epoch scalar, so
+        regen itself moves inside the program: an outer ``lax.scan`` over
+        epochs regenerates each epoch's index tensor in-program (via
+        ``ops.xla.build_evaluator``) and an inner scan drives ``step_fn``
+        over its batches — an entire training run with ZERO host
+        round-trips, the logical extreme of the on-device design (even
+        ``set_epoch``'s one async dispatch per epoch disappears).
+
+        ``step_fn`` as in :meth:`run_epoch`.  With ``collect=True`` the
+        stacked outputs have shape ``[n_epochs, steps, ...]``.  Note the
+        epoch index tensor lives in HBM once per live epoch (the scan
+        carries none across epochs).  The iterator's epoch cache is not
+        consulted — regen is recomputed in-program, bit-identically.
+        """
+        whole = self.num_samples // self.batch
+        if whole == 0:
+            raise ValueError("batch exceeds the rank's whole-batch budget")
+        if int(n_epochs) < 1:
+            raise ValueError(f"n_epochs must be >= 1, got {n_epochs}")
+
+        def build():
+            over = self._step_scan_body(step_fn, collect)
+            ev = build_evaluator(
+                self.n, self.window, self.world,
+                drop_last=self.kwargs.get("drop_last", False),
+                order_windows=self.kwargs.get("order_windows", True),
+                partition=self.kwargs.get("partition", "strided"),
+                rounds=self.kwargs.get("rounds", core.DEFAULT_ROUNDS),
+                shuffle=self.kwargs.get("shuffle", True),
+            )
+            seed_lo, seed_hi = core.fold_seed(self.seed)
+            base = jnp.asarray(
+                [seed_lo & 0xFFFFFFFF, seed_hi & 0xFFFFFFFF, 0,
+                 self.rank & 0xFFFFFFFF],
+                dtype=jnp.uint32,
+            )
+
+            @jax.jit
+            def runner(carry, first):
+                def epoch_body(c, e):
+                    sv = base.at[2].set(e.astype(jnp.uint32))
+                    idx = ev(sv)
+                    return jax.lax.scan(
+                        over(idx), c, jnp.arange(whole, dtype=jnp.int32)
+                    )
+
+                return jax.lax.scan(
+                    epoch_body, carry,
+                    first + jnp.arange(n_epochs, dtype=jnp.int32),
+                )
+
+            return runner
+
+        runner = self._cached_runner(
+            (step_fn, "epochs", int(n_epochs), bool(collect)), build
+        )
+        carry, ys = runner(carry, jnp.int32(first_epoch))
+        return (carry, ys) if collect else carry

@@ -174,6 +174,46 @@ def test_run_epoch_default_clamps_to_whole_batches():
     assert int(c) == 12 and ys.shape == (12,)
 
 
+def test_run_epochs_whole_training_in_one_program():
+    # regen moves inside the program: 3 epochs scanned in one dispatch must
+    # equal 3 sequential run_epoch calls exactly (integer carry)
+    it = DeviceEpochIterator(n=2048, window=128, batch=64, seed=5, rank=1,
+                             world=2)
+    step = lambda c, idx: (c + idx.sum(), idx[0])
+
+    manual_c = jnp.int32(0)
+    manual_firsts = []
+    for e in range(3, 6):
+        manual_c, ys = it.run_epoch(e, step, manual_c, collect=True)
+        manual_firsts.append(np.asarray(ys))
+    fused_c, fused_ys = it.run_epochs(3, 3, step, jnp.int32(0), collect=True)
+    assert int(fused_c) == int(manual_c)
+    assert fused_ys.shape == (3, it.num_samples // it.batch)
+    np.testing.assert_array_equal(np.asarray(fused_ys),
+                                  np.stack(manual_firsts))
+
+
+def test_run_epochs_validation():
+    with pytest.raises(ValueError, match="rank"):
+        DeviceEpochIterator(n=2048, window=128, batch=64, rank=5, world=2)
+    it = DeviceEpochIterator(n=512, window=32, batch=32, world=1)
+    with pytest.raises(ValueError, match="n_epochs"):
+        it.run_epochs(0, 0, lambda c, i: c, jnp.int32(0))
+
+
+def test_run_epochs_no_collect_and_reuse():
+    it = DeviceEpochIterator(n=512, window=32, batch=32, world=1)
+    step = lambda c, idx: c + idx.sum()
+    a = it.run_epochs(0, 2, step, jnp.int32(0))
+    b = it.run_epochs(0, 2, step, jnp.int32(0))  # cached runner, same value
+    assert int(a) == int(b)
+    ref = jnp.int32(0)
+    for e in range(2):
+        for bt in it.epoch(e):
+            ref = ref + bt.sum()
+    assert int(a) == int(ref)
+
+
 def test_run_epoch_runner_cache_bounded_and_lru():
     it = DeviceEpochIterator(n=256, window=16, batch=32, world=1)
     hot = lambda c, i: c + i.sum()
