@@ -120,15 +120,11 @@ def make_train_step(cfg: GPTConfig, tx, mesh: Mesh, batch_per_dp: int):
     )
 
 
-def make_epoch_runner(cfg: GPTConfig, tx, mesh: Mesh, batch_per_dp: int,
-                      steps_per_epoch: int):
-    """Jitted full EPOCH: ``lax.scan`` over the train steps, so an entire
-    epoch of sharded steps — batch gathers, collectives, updates — is one
-    dispatch (the per-device analogue is DeviceEpochIterator.run_epoch).
-
-    Signature: ``(params, opt_state, tokens, epoch_idx) ->
-    (params, opt_state, losses[steps_per_epoch])``.
-    """
+def _make_epoch_math(cfg: GPTConfig, tx, mesh: Mesh, batch_per_dp: int,
+                     steps_per_epoch: int):
+    """The un-jitted whole-epoch scan shared by the per-epoch and
+    whole-run entry points: ``(params, opt_state, tokens, epoch_idx) ->
+    (params, opt_state, losses[steps_per_epoch])``."""
     step_fn = _make_step_math(cfg, tx, mesh, batch_per_dp)
 
     def epoch_fn(params, opt_state, tokens, epoch_idx):
@@ -145,7 +141,81 @@ def make_epoch_runner(cfg: GPTConfig, tx, mesh: Mesh, batch_per_dp: int,
         )
         return params, opt_state, losses
 
-    return jax.jit(epoch_fn, donate_argnums=(0, 1))
+    return epoch_fn
+
+
+def make_epoch_runner(cfg: GPTConfig, tx, mesh: Mesh, batch_per_dp: int,
+                      steps_per_epoch: int):
+    """Jitted full EPOCH: ``lax.scan`` over the train steps, so an entire
+    epoch of sharded steps — batch gathers, collectives, updates — is one
+    dispatch (the per-device analogue is DeviceEpochIterator.run_epoch).
+
+    Signature: ``(params, opt_state, tokens, epoch_idx) ->
+    (params, opt_state, losses[steps_per_epoch])``.
+    """
+    return jax.jit(
+        _make_epoch_math(cfg, tx, mesh, batch_per_dp, steps_per_epoch),
+        donate_argnums=(0, 1),
+    )
+
+
+def make_run_runner(cfg: GPTConfig, tx, mesh: Mesh, batch_per_dp: int,
+                    steps_per_epoch: int, n_epochs: int, n_samples: int,
+                    window: int, *, axis: str = "dp",
+                    sampler_kwargs: Optional[dict] = None):
+    """The ENTIRE multi-epoch sharded run as one jitted program.
+
+    The distributed analogue of ``DeviceEpochIterator.run_epochs``: an
+    outer ``lax.scan`` over epochs regenerates each epoch's mesh-sharded
+    index tensor IN-program — the ``shard_map``'ped ICI seed-agreement +
+    windowed-permutation evaluator nests inside the scan body — and the
+    inner scan drives the sharded train steps.  Zero host round-trips for
+    the whole run; ``set_epoch`` ceases to exist as a host event.
+
+    Signature: ``(params, opt_state, tokens, triple, first_epoch) ->
+    (params, opt_state, losses[n_epochs, steps_per_epoch])`` where
+    ``triple`` is the uint32[world, 3] per-device (seed_lo, seed_hi, _)
+    array (epoch slot overwritten per scanned epoch) laid out like
+    ``sharded_epoch_indices``'s input.
+    """
+    from ..parallel.sharded import _compiled_sharded
+    from ..ops import core as _core
+
+    kw = dict(shuffle=True, drop_last=False, order_windows=True,
+              partition="strided", rounds=_core.DEFAULT_ROUNDS)
+    kw.update(sampler_kwargs or {})
+    world = mesh.shape[axis]
+    regen_fn, num_samples = _compiled_sharded(
+        mesh, axis, int(n_samples), int(window), int(world), kw["shuffle"],
+        kw["drop_last"], kw["order_windows"], kw["partition"], kw["rounds"],
+    )
+    whole = num_samples // batch_per_dp
+    if not 0 < steps_per_epoch <= whole:
+        # dynamic_slice would silently CLAMP an oversized start offset and
+        # re-train the trailing window — refuse instead
+        raise ValueError(
+            f"steps_per_epoch={steps_per_epoch} not in [1, {whole}] "
+            f"({num_samples} samples/rank / batch_per_dp={batch_per_dp})"
+        )
+    epoch_fn = _make_epoch_math(cfg, tx, mesh, batch_per_dp, steps_per_epoch)
+
+    def run_fn(params, opt_state, tokens, triple, first_epoch):
+        def epoch_body(carry, e):
+            params, opt_state = carry
+            t = triple.at[:, 2].set(e.astype(jnp.uint32))
+            epoch_idx = regen_fn(t)  # nested jit inlines; shard_map scans
+            params, opt_state, losses = epoch_fn(
+                params, opt_state, tokens, epoch_idx
+            )
+            return (params, opt_state), losses
+
+        (params, opt_state), losses = jax.lax.scan(
+            epoch_body, (params, opt_state),
+            first_epoch + jnp.arange(n_epochs, dtype=jnp.int32),
+        )
+        return params, opt_state, losses
+
+    return jax.jit(run_fn, donate_argnums=(0, 1))
 
 
 def demo_training_run(
@@ -159,12 +229,15 @@ def demo_training_run(
     epochs: int = 2,
     seed: int = 0,
     scan_epochs: bool = False,
+    one_program: bool = False,
 ) -> list:
     """The minimum end-to-end slice (SURVEY.md §7 build order #3, scaled to
     the test mesh): synthetic token dataset -> per-epoch on-device regen with
     ICI seed agreement -> sharded train steps.  Returns per-step losses.
     ``scan_epochs=True`` drives each epoch through ``make_epoch_runner``
-    (one dispatch per epoch) instead of a Python step loop."""
+    (one dispatch per epoch); ``one_program=True`` runs the ENTIRE run
+    through ``make_run_runner`` (regen scanned in-program, one dispatch
+    total)."""
     cfg = cfg or GPTConfig()
     tokens = jax.random.randint(
         jax.random.PRNGKey(seed + 1), (n_samples, cfg.seq_len + 1), 0,
@@ -172,6 +245,15 @@ def demo_training_run(
     )
     params, opt_state, tx = create_sharded_state(cfg, mesh, seed)
     losses = []
+    if one_program:
+        from ..parallel.sharded import make_seed_triple
+
+        run = make_run_runner(cfg, tx, mesh, batch_per_dp, steps_per_epoch,
+                              epochs, n_samples, window)
+        triple_arr = make_seed_triple(mesh, seed, 0, axis="dp")
+        params, opt_state, ls = run(params, opt_state, tokens, triple_arr,
+                                    jnp.int32(0))
+        return [float(l) for l in np.asarray(ls).reshape(-1)]
     if scan_epochs:
         run = make_epoch_runner(cfg, tx, mesh, batch_per_dp, steps_per_epoch)
     else:

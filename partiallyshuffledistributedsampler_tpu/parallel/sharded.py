@@ -76,6 +76,28 @@ def _compiled_sharded(
     return jax.jit(fn, in_shardings=(in_sharding,)), num_samples
 
 
+def make_seed_triple(mesh: Mesh, seed, epoch, *, axis: str = "data",
+                     local_seeds=None) -> jax.Array:
+    """The mesh-sharded uint32[world, 3] (seed_lo, seed_hi, epoch) input
+    the regen program consumes — the ONE place the triple layout lives.
+
+    Built as a global device array from a process-local numpy view —
+    required in multi-process SPMD, harmless single-process (each process
+    furnishes only its addressable rows)."""
+    world = mesh.shape[axis]
+    if local_seeds is None:
+        lo, hi = core.fold_seed(seed)
+        triple = np.asarray([[lo, hi, int(epoch)]] * world, dtype=np.uint32)
+    else:
+        triple = np.asarray(local_seeds, dtype=np.uint32)
+        if triple.shape != (world, 3):
+            raise ValueError(f"local_seeds must be [world={world}, 3]")
+    sharding = NamedSharding(mesh, P(axis, None))
+    return jax.make_array_from_callback(
+        triple.shape, sharding, lambda idx: triple[idx]
+    )
+
+
 def sharded_epoch_indices(
     mesh: Mesh,
     n: int,
@@ -104,20 +126,6 @@ def sharded_epoch_indices(
         mesh, axis, int(n), int(window), int(world), bool(shuffle),
         bool(drop_last), bool(order_windows), str(partition), int(rounds),
     )
-    if local_seeds is None:
-        lo, hi = core.fold_seed(seed)
-        triple = np.asarray(
-            [[lo, hi, int(epoch)]] * world, dtype=np.uint32
-        )
-    else:
-        triple = np.asarray(local_seeds, dtype=np.uint32)
-        if triple.shape != (world, 3):
-            raise ValueError(f"local_seeds must be [world={world}, 3]")
-    # Build a global device array from the (process-local) numpy triple —
-    # required in multi-process SPMD, harmless single-process.  Every process
-    # holds the same global view; each furnishes only its addressable rows.
-    sharding = NamedSharding(mesh, P(axis, None))
-    triple_arr = jax.make_array_from_callback(
-        triple.shape, sharding, lambda idx: triple[idx]
-    )
+    triple_arr = make_seed_triple(mesh, seed, epoch, axis=axis,
+                                  local_seeds=local_seeds)
     return fn(triple_arr)

@@ -72,6 +72,19 @@ def test_scanned_epoch_runner_matches_step_loop():
     np.testing.assert_allclose(scanned, stepped, rtol=1e-5, atol=1e-6)
 
 
+def test_one_program_run_matches_step_loop():
+    # make_run_runner: the ENTIRE run in one program — shard_map regen
+    # (ICI seed agreement included) scanned inside the jitted epochs loop
+    # — must reproduce the per-step trajectory
+    mesh = make_mesh(8)
+    kw = dict(n_samples=64, window=16, batch_per_dp=2, steps_per_epoch=2,
+              epochs=2)
+    stepped = demo_training_run(mesh, TINY, **kw)
+    whole = demo_training_run(mesh, TINY, one_program=True, **kw)
+    assert len(whole) == len(stepped) == 4
+    np.testing.assert_allclose(whole, stepped, rtol=1e-5, atol=1e-6)
+
+
 def test_training_deterministic_across_meshes():
     # dp=4,tp=2 vs dp=2,tp=2: same data order per epoch (the sampler contract
     # holds per dp-world); losses differ because dp-world differs — but a
