@@ -29,6 +29,8 @@ from .ops import (  # noqa: F401
     epoch_indices_jax,
     epoch_indices_np,
     shard_sizes,
+    stream_indices_at_jax,
+    stream_indices_at_np,
 )
 
 

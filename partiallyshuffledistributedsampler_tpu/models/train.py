@@ -161,8 +161,7 @@ def make_epoch_runner(cfg: GPTConfig, tx, mesh: Mesh, batch_per_dp: int,
 
 def make_run_runner(cfg: GPTConfig, tx, mesh: Mesh, batch_per_dp: int,
                     steps_per_epoch: int, n_epochs: int, n_samples: int,
-                    window: int, *, axis: str = "dp",
-                    sampler_kwargs: Optional[dict] = None):
+                    window: int, *, sampler_kwargs: Optional[dict] = None):
     """The ENTIRE multi-epoch sharded run as one jitted program.
 
     The distributed analogue of ``DeviceEpochIterator.run_epochs``: an
@@ -175,19 +174,24 @@ def make_run_runner(cfg: GPTConfig, tx, mesh: Mesh, batch_per_dp: int,
     Signature: ``(params, opt_state, tokens, triple, first_epoch) ->
     (params, opt_state, losses[n_epochs, steps_per_epoch])`` where
     ``triple`` is the uint32[world, 3] per-device (seed_lo, seed_hi, _)
-    array (epoch slot overwritten per scanned epoch) laid out like
-    ``sharded_epoch_indices``'s input.
+    array (epoch slot overwritten per scanned epoch) from
+    ``parallel.make_seed_triple(mesh, seed, 0, axis="dp")``.  The train
+    math is pinned to the ``"dp"`` mesh axis (like the rest of this
+    module); ``sampler_kwargs`` forwards permutation options to
+    ``parallel.make_regen_fn``.
     """
-    from ..parallel.sharded import _compiled_sharded
-    from ..ops import core as _core
+    from ..parallel.sharded import make_regen_fn
 
-    kw = dict(shuffle=True, drop_last=False, order_windows=True,
-              partition="strided", rounds=_core.DEFAULT_ROUNDS)
-    kw.update(sampler_kwargs or {})
-    world = mesh.shape[axis]
-    regen_fn, num_samples = _compiled_sharded(
-        mesh, axis, int(n_samples), int(window), int(world), kw["shuffle"],
-        kw["drop_last"], kw["order_windows"], kw["partition"], kw["rounds"],
+    kw = dict(sampler_kwargs or {})
+    allowed = {"shuffle", "drop_last", "order_windows", "partition", "rounds"}
+    unknown = set(kw) - allowed
+    if unknown:
+        raise ValueError(
+            f"unknown sampler_kwargs {sorted(unknown)}; allowed: "
+            f"{sorted(allowed)}"
+        )
+    regen_fn, num_samples = make_regen_fn(
+        mesh, n_samples, window, axis="dp", **kw
     )
     whole = num_samples // batch_per_dp
     if not 0 < steps_per_epoch <= whole:

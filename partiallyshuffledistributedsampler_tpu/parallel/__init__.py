@@ -6,4 +6,8 @@ from .mesh import (  # noqa: F401
     identity_from_mesh,
     local_ranks_from_mesh,
 )
-from .sharded import make_seed_triple, sharded_epoch_indices  # noqa: F401
+from .sharded import (  # noqa: F401
+    make_regen_fn,
+    make_seed_triple,
+    sharded_epoch_indices,
+)

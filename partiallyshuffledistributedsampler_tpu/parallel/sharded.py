@@ -76,6 +76,32 @@ def _compiled_sharded(
     return jax.jit(fn, in_shardings=(in_sharding,)), num_samples
 
 
+def make_regen_fn(
+    mesh: Mesh,
+    n: int,
+    window: int,
+    *,
+    axis: str = "data",
+    shuffle: bool = True,
+    drop_last: bool = False,
+    order_windows: bool = True,
+    partition: str = "strided",
+    rounds: int = core.DEFAULT_ROUNDS,
+):
+    """Public access to the compiled mesh-sharded regen program:
+    ``(fn, num_samples)`` where ``fn(triple) -> int32[world, num_samples]``
+    (triple from :func:`make_seed_triple`).  ``fn`` is jitted but composes
+    into larger jitted programs (nested jit inlines) — this is how
+    ``models/train.make_run_runner`` scans regen inside a whole-run
+    program.  The defaults here are the single source of truth shared
+    with :func:`sharded_epoch_indices`."""
+    world = mesh.shape[axis]
+    return _compiled_sharded(
+        mesh, axis, int(n), int(window), int(world), bool(shuffle),
+        bool(drop_last), bool(order_windows), str(partition), int(rounds),
+    )
+
+
 def make_seed_triple(mesh: Mesh, seed, epoch, *, axis: str = "data",
                      local_seeds=None) -> jax.Array:
     """The mesh-sharded uint32[world, 3] (seed_lo, seed_hi, epoch) input
@@ -121,10 +147,9 @@ def sharded_epoch_indices(
     optionally supplies each device's own (seed_lo, seed_hi, epoch) triple to
     exercise the agreement collective — rank 0's row wins by construction.
     """
-    world = mesh.shape[axis]
-    fn, _num = _compiled_sharded(
-        mesh, axis, int(n), int(window), int(world), bool(shuffle),
-        bool(drop_last), bool(order_windows), str(partition), int(rounds),
+    fn, _num = make_regen_fn(
+        mesh, n, window, axis=axis, shuffle=shuffle, drop_last=drop_last,
+        order_windows=order_windows, partition=partition, rounds=rounds,
     )
     triple_arr = make_seed_triple(mesh, seed, epoch, axis=axis,
                                   local_seeds=local_seeds)

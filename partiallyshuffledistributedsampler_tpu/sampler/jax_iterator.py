@@ -40,6 +40,13 @@ class DeviceEpochIterator:
     so the next epoch's permutation is computed while this epoch trains —
     regen latency is fully hidden, which is how the "<1 ms" budget becomes
     "0 ms observed" in a real loop.
+
+    ``epoch()`` costs one eager slice dispatch per step (microseconds on
+    real hardware).  Loops whose body is jittable should prefer
+    :meth:`run_epoch` (whole epoch, one dispatch) or :meth:`run_epochs`
+    (whole run, one dispatch, regen in-program) — same values, no
+    per-step dispatches at all; the noise-subtracted stall harness
+    (benchmarks/stall_native.py) measures exactly this difference.
     """
 
     def __init__(
