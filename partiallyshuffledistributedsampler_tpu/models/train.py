@@ -182,16 +182,10 @@ def make_run_runner(cfg: GPTConfig, tx, mesh: Mesh, batch_per_dp: int,
     """
     from ..parallel.sharded import make_regen_fn
 
-    kw = dict(sampler_kwargs or {})
-    allowed = {"shuffle", "drop_last", "order_windows", "partition", "rounds"}
-    unknown = set(kw) - allowed
-    if unknown:
-        raise ValueError(
-            f"unknown sampler_kwargs {sorted(unknown)}; allowed: "
-            f"{sorted(allowed)}"
-        )
+    # unknown keys raise TypeError from make_regen_fn's keyword-only
+    # signature — no separate allowlist to keep in sync
     regen_fn, num_samples = make_regen_fn(
-        mesh, n_samples, window, axis="dp", **kw
+        mesh, n_samples, window, axis="dp", **(sampler_kwargs or {})
     )
     whole = num_samples // batch_per_dp
     if not 0 < steps_per_epoch <= whole:

@@ -391,6 +391,12 @@ def epoch_indices_jax(
     """
     import numpy as np
 
+    if int(window) < 1:
+        # the numpy path raises this inside windowed_perm; here the
+        # amortization gate would otherwise divide by zero first
+        raise ValueError(f"window must be >= 1, got {int(window)}")
+    if int(world) < 1:
+        raise ValueError(f"world must be >= 1, got {int(world)}")
     amortized = bool(amortize) and _amortized_applicable(
         int(n), int(window), int(world), bool(shuffle), str(partition)
     )

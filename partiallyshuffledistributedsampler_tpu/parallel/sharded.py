@@ -93,8 +93,8 @@ def make_regen_fn(
     (triple from :func:`make_seed_triple`).  ``fn`` is jitted but composes
     into larger jitted programs (nested jit inlines) — this is how
     ``models/train.make_run_runner`` scans regen inside a whole-run
-    program.  The defaults here are the single source of truth shared
-    with :func:`sharded_epoch_indices`."""
+    program.  :func:`sharded_epoch_indices` routes through here; keep
+    the two signatures' permutation defaults in step."""
     world = mesh.shape[axis]
     return _compiled_sharded(
         mesh, axis, int(n), int(window), int(world), bool(shuffle),

@@ -256,3 +256,18 @@ def test_randomized_sweep():
         assert counts.sum() == total
         assert counts.min() >= total // n
         assert counts.max() <= -(-total // n)
+
+
+def test_jax_degenerate_configs_raise_named_errors():
+    # the jax entry point must match the numpy path's named errors, not
+    # leak a ZeroDivisionError from the amortization gate
+    from partiallyshuffledistributedsampler_tpu.ops.xla import (
+        epoch_indices_jax,
+    )
+
+    with pytest.raises(ValueError, match="window"):
+        epoch_indices_jax(100, 0, 0, 0, 0, 2)
+    with pytest.raises(ValueError, match="dataset size"):
+        epoch_indices_jax(0, 64, 0, 0, 0, 2)
+    with pytest.raises(ValueError, match="world"):
+        epoch_indices_jax(100, 64, 0, 0, 0, 0)
