@@ -232,6 +232,13 @@ def demo_training_run(
     """The minimum end-to-end slice (SURVEY.md §7 build order #3, scaled to
     the test mesh): synthetic token dataset -> per-epoch on-device regen with
     ICI seed agreement -> sharded train steps.  Returns per-step losses.
+
+    Single-process (possibly multi-device) demo: ``create_sharded_state``
+    uses plain ``device_put``, which requires all mesh devices to be
+    addressable.  The SAMPLER side is multi-process-proven separately
+    (tests/test_multihost.py) — a multi-host consumer builds its params
+    via ``jax.make_array_from_callback`` and reuses the same
+    ``make_regen_fn``/``make_seed_triple`` calls unchanged.
     ``scan_epochs=True`` drives each epoch through ``make_epoch_runner``
     (one dispatch per epoch); ``one_program=True`` runs the ENTIRE run
     through ``make_run_runner`` (regen scanned in-program, one dispatch
