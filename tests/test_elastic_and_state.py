@@ -63,22 +63,14 @@ def test_elastic_exactly_once(old_world, new_world, partition):
         assert len(got) == len(s) == s._effective_num_samples
         remainder_vals += got
 
-    # exactly-once: consumed + remainder == full epoch stream + wrap-pad extras
+    # exactly-once: consumed + remainder == full epoch stream + wrap-pad
+    # extras drawn only from the unconsumed portion (shared Counter-based
+    # assertion — tests/test_hypothesis_properties.py)
+    from test_hypothesis_properties import assert_exactly_once
+
     stream = _epoch_stream(n, window, seed, epoch, old_world)
-    R = len(stream) - consumed * old_world
-    ns_new = -(-R // new_world)
-    n_extra = ns_new * new_world - R
-    combined = sorted(consumed_vals + remainder_vals)
-    assert len(combined) == len(stream) + n_extra
-    # the full epoch multiset is covered...
-    full = sorted(stream.tolist())
-    extra = list(combined)
-    for v in full:
-        extra.remove(v)  # raises if missing
-    # ...and the extras are legal wrap-pad values (head of the remainder)
-    remainder_set = set(stream.tolist())
-    assert all(v in remainder_set for v in extra)
-    assert len(extra) == n_extra
+    assert_exactly_once(consumed_vals, remainder_vals, stream, old_world,
+                        consumed, partition, new_world)
 
 
 def test_elastic_epoch_zero_consumed():

@@ -181,3 +181,87 @@ def test_cpu_xla_parity(cfg):
     ))
     assert got.dtype == ref.dtype
     np.testing.assert_array_equal(got, ref)
+
+
+def assert_exactly_once(consumed_vals, remainder_vals, stream, old_world,
+                        consumed, partition, new_world):
+    """SPEC.md §6's exactly-once law, assertable from outputs alone:
+    consumed prefix + all new ranks' remainders must equal the full epoch
+    stream as a multiset, plus exactly the wrap-pad count of extras, and
+    every extra must be a value from the UNCONSUMED portion of the stream
+    (an implementation padding with already-consumed indices must fail).
+    Shared with tests/test_elastic_and_state.py."""
+    from collections import Counter
+
+    total = len(stream)
+    ns_old = total // old_world
+    R = total - consumed * old_world
+    ns_new = -(-R // new_world)
+    n_extra = ns_new * new_world - R
+    combined = Counter(consumed_vals) + Counter(remainder_vals)
+    full = Counter(stream.tolist())
+    missing = full - combined
+    assert not missing, f"missing epoch values: {list(missing.items())[:5]}"
+    extras = combined - full
+    assert sum(extras.values()) == n_extra, (sum(extras.values()), n_extra)
+    if partition == "strided":
+        unconsumed = stream[old_world * consumed:]
+    else:  # blocked: each old rank consumed the head of its block
+        p = np.arange(total)
+        unconsumed = stream[(p % ns_old) >= consumed]
+    allowed = Counter(unconsumed.tolist())
+    assert not (extras - allowed), "wrap-pad extras not from the remainder"
+
+
+@settings(max_examples=30, **SETTINGS)
+@given(cfg=st.fixed_dictionaries(dict(
+    n=st.integers(10, 2000),
+    window=st.integers(1, 300),
+    old_world=st.integers(1, 6),
+    new_world=st.integers(1, 6),
+    seed=st.integers(0, 2**63 - 1),
+    epoch=st.integers(0, 50),
+    partition=st.sampled_from(["strided", "blocked"]),
+    frac=st.floats(0.0, 1.0),
+)))
+def test_elastic_exactly_once_property(cfg):
+    """SPEC.md §6 under hypothesis: for random (old_world -> new_world)
+    reshards at a random mid-epoch offset, consumed prefix + all new
+    ranks' remainders == the full epoch stream plus only legal wrap-pad
+    extras.  Generalizes the fixed-grid cases in test_elastic_and_state."""
+    from partiallyshuffledistributedsampler_tpu import (
+        PartiallyShuffleDistributedSampler as S,
+    )
+
+    n, w = cfg["n"], cfg["window"]
+    ow, nw_ = cfg["old_world"], cfg["new_world"]
+    num_samples, _ = core.shard_sizes(n, ow, False)
+    assume(num_samples >= 2)
+    consumed = min(int(cfg["frac"] * num_samples), num_samples - 1)
+
+    old = [
+        S(n, num_replicas=ow, rank=r, window=w, seed=cfg["seed"],
+          partition=cfg["partition"], backend="cpu")
+        for r in range(ow)
+    ]
+    consumed_vals = []
+    for s in old:
+        s.set_epoch(cfg["epoch"])
+        it = iter(s)
+        consumed_vals += [next(it) for _ in range(consumed)]
+        it.close()
+    state = old[0].state_dict()
+    assert state["offset"] == consumed
+
+    remainder_vals = []
+    for r in range(nw_):
+        es = S.reshard_from_state_dict(
+            state, num_replicas=nw_, rank=r, backend="cpu"
+        )
+        remainder_vals += list(es)
+
+    stream = cpu.full_epoch_stream_np(
+        n, w, cfg["seed"], cfg["epoch"], world=ow
+    )
+    assert_exactly_once(consumed_vals, remainder_vals, stream, ow,
+                        consumed, cfg["partition"], nw_)
