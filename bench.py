@@ -202,6 +202,13 @@ def main() -> None:
     except Exception as exc:
         details["cpu_error"] = repr(exc)[:200]
 
+    # interim details to stderr BEFORE the slow stall tier: a driver-side
+    # timeout mid-stall then still leaves the evaluator fits on record
+    # (the final line below supersedes this one when the run completes;
+    # smoke mode skips the stall tier so no interim line is needed)
+    if not smoke:
+        print(json.dumps(details), file=sys.stderr, flush=True)
+
     # driver metric #2: data-pipeline stall %, noise-subtracted (sampler
     # arm minus constant-data arm; methodology in benchmarks/stall_native.py)
     if not smoke:

@@ -66,7 +66,7 @@ def test_elastic_exactly_once(old_world, new_world, partition):
     # exactly-once: consumed + remainder == full epoch stream + wrap-pad
     # extras drawn only from the unconsumed portion (shared Counter-based
     # assertion — tests/test_hypothesis_properties.py)
-    from test_hypothesis_properties import assert_exactly_once
+    from conftest import assert_exactly_once
 
     stream = _epoch_stream(n, window, seed, epoch, old_world)
     assert_exactly_once(consumed_vals, remainder_vals, stream, old_world,

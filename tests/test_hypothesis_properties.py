@@ -183,34 +183,7 @@ def test_cpu_xla_parity(cfg):
     np.testing.assert_array_equal(got, ref)
 
 
-def assert_exactly_once(consumed_vals, remainder_vals, stream, old_world,
-                        consumed, partition, new_world):
-    """SPEC.md §6's exactly-once law, assertable from outputs alone:
-    consumed prefix + all new ranks' remainders must equal the full epoch
-    stream as a multiset, plus exactly the wrap-pad count of extras, and
-    every extra must be a value from the UNCONSUMED portion of the stream
-    (an implementation padding with already-consumed indices must fail).
-    Shared with tests/test_elastic_and_state.py."""
-    from collections import Counter
-
-    total = len(stream)
-    ns_old = total // old_world
-    R = total - consumed * old_world
-    ns_new = -(-R // new_world)
-    n_extra = ns_new * new_world - R
-    combined = Counter(consumed_vals) + Counter(remainder_vals)
-    full = Counter(stream.tolist())
-    missing = full - combined
-    assert not missing, f"missing epoch values: {list(missing.items())[:5]}"
-    extras = combined - full
-    assert sum(extras.values()) == n_extra, (sum(extras.values()), n_extra)
-    if partition == "strided":
-        unconsumed = stream[old_world * consumed:]
-    else:  # blocked: each old rank consumed the head of its block
-        p = np.arange(total)
-        unconsumed = stream[(p % ns_old) >= consumed]
-    allowed = Counter(unconsumed.tolist())
-    assert not (extras - allowed), "wrap-pad extras not from the remainder"
+from conftest import assert_exactly_once  # shared SPEC §6 law assertion
 
 
 @settings(max_examples=30, **SETTINGS)
