@@ -23,8 +23,17 @@ PY ?= python
 
 .PHONY: check test bench native dryrun
 
+# the driver parses the LAST line of bench.py's combined output (round 3
+# lost its headline to the details line — BENCH_r03.json "parsed": null),
+# so the gate replicates that read and asserts it yields the metric
 check: test dryrun
-	PSDS_BENCH_SMOKE=1 $(PY) bench.py
+	PSDS_BENCH_SMOKE=1 $(PY) bench.py >.bench_smoke.out 2>&1 \
+		|| { cat .bench_smoke.out; exit 1; }
+	@cat .bench_smoke.out
+	tail -n 1 .bench_smoke.out | $(PY) -c "import json,sys; \
+	d = json.loads(sys.stdin.readline()); \
+	assert 'metric' in d and 'value' in d, d; \
+	print('bench last-line parse OK:', d['metric'], d['value'], d['unit'])"
 	@echo "make check: all gates green"
 
 test:
