@@ -373,6 +373,11 @@ def torch_stall(world: int, backend: str, *, n: int = N_TORCH,
         sampler.set_epoch(10_000)  # warmup epoch: compile/alloc one-time costs
         for _ in loader:
             break
+        timer = getattr(sampler, "regen_timer", None)
+        if timer is not None:
+            # the warmup regen carries compile time; it must not inflate
+            # the steady-state epoch_regen_ms this function reports
+            timer.samples_ms.clear()
         t0 = time.perf_counter()
         for e in range(epochs):
             sampler.set_epoch(e)
@@ -389,6 +394,9 @@ def torch_stall(world: int, backend: str, *, n: int = N_TORCH,
     return {
         "world": world,
         "backend": backend,
+        # what 'auto' resolved to (== backend when pinned): the r4 law under
+        # test is auto <= min(cpu, xla) at every world
+        "resolved_backend": ours.backend,
         "n": n,
         "sampler_wall_s": round(ts, 4),
         "constant_wall_s": round(tc, 4),
@@ -404,7 +412,8 @@ def torch_stall(world: int, backend: str, *, n: int = N_TORCH,
     }
 
 
-def summarize(worlds=(8, 64, 256), torch_backends=("cpu", "xla")) -> dict:
+def summarize(worlds=(8, 64, 256),
+              torch_backends=("cpu", "xla", "auto")) -> dict:
     """The bench.py embed: stall % per world for the native tier and per
     (backend, world) for the torch tier."""
     out: dict = {"native": {}, "torch": {}}
@@ -432,6 +441,10 @@ def summarize(worlds=(8, 64, 256), torch_backends=("cpu", "xla")) -> dict:
                         r["sampler_overhead_ms_per_epoch"],
                     "epoch_wall_ms": r["epoch_wall_ms"],
                 }
+                if b == "auto":
+                    out["torch"][f"{b}_{w}"]["resolved_backend"] = (
+                        r["resolved_backend"]
+                    )
             except Exception as exc:
                 out["torch"][f"{b}_{w}"] = {"error": repr(exc)[:150]}
     return out

@@ -103,10 +103,13 @@ def mix32(xp: Any, x):
 def fold_seed(seed) -> tuple:
     """Normalize a seed into the spec's (lo, hi) uint32 pair (SPEC.md §1).
 
-    Accepts python/numpy ints of any size (hi/lo split), an existing
-    (lo, hi) pair (passed through), or a traced uint32 scalar (hi = 0).
-    Single source of truth — every backend folds seeds through here so a
-    change can never desynchronize them.
+    Accepts python/numpy ints of any size (hi/lo split; negatives wrap
+    two's-complement like any later dtype cast would), an existing
+    (lo, hi) pair (validated: length 2, concrete halves in uint32 range —
+    an oversized half would otherwise flow through and wrap silently at
+    the dtype cast), or a traced uint32 scalar (hi = 0).  Single source of
+    truth — every backend folds seeds through here so a change can never
+    desynchronize them.
     """
     import numpy as _np
 
@@ -114,6 +117,19 @@ def fold_seed(seed) -> tuple:
         s = int(seed)
         return (s & _M32, (s >> 32) & _M32)
     if isinstance(seed, tuple):
+        if len(seed) != 2:
+            raise ValueError(
+                f"seed tuple must be (lo, hi), got length {len(seed)}"
+            )
+        for name, half in zip(("lo", "hi"), seed):
+            if isinstance(half, (int, _np.integer)) and not (
+                0 <= int(half) <= _M32
+            ):
+                raise ValueError(
+                    f"seed tuple {name}={int(half)} outside uint32 range "
+                    f"[0, 2**32) — fold a wide seed by passing the int "
+                    f"itself, not a hand-split pair"
+                )
         return seed
     return (seed, 0)
 
@@ -412,6 +428,57 @@ def compose_remainder_chain(xp: Any, q, chain, partition: str, pos_dtype):
     return remaining_stream_positions(
         xp, q, world, ns, consumed, partition, pos_dtype
     )
+
+
+def elastic_chain(n: int, layers, new_world: int, drop_last: bool = False):
+    """Validate a reshard cascade and size the current remainder
+    (SPEC.md §6/§6.1) — the ONE place the layer-sizing law lives; the torch
+    shim and the mesh-sharded program both call it.
+
+    ``layers`` is ``[(world, consumed), ...]`` outermost first: layer 0 ran
+    the base epoch at ``world_0`` ranks, each consuming ``consumed_0``;
+    every later layer ran the previous layer's remainder.  Returns
+    ``(chain, remaining, num_samples)``: the ``(world, ns, consumed)``
+    triples ``compose_remainder_chain`` consumes (``ns`` recomputed, never
+    trusted from a checkpoint), the innermost remainder count ``R_last``,
+    and the per-rank length at ``new_world``.  Pure — callers can finish
+    all validation before committing any state.
+    """
+    layers = list(layers)
+    if not layers:
+        raise ValueError(
+            "reshard cascade is empty: layers must hold at least the base "
+            "epoch's (world, consumed) pair"
+        )
+    chain = []
+    domain = None  # None = the base epoch; else the remaining count
+    for world, consumed in layers:
+        world, consumed = int(world), int(consumed)
+        if domain is None:
+            ns, _ = shard_sizes(n, world, drop_last)
+        else:
+            if world < 1:
+                raise ValueError(f"world must be >= 1, got {world}")
+            # the remainder-epoch length law, replayed for the world that
+            # consumed it: drop_last floors (no duplicates), else ceil+wrap
+            if drop_last:
+                ns = domain // world
+            else:
+                ns = -(-domain // world) if domain else 0
+        if not (0 <= consumed <= ns):
+            raise ValueError(
+                f"consumed {consumed} outside [0, {ns}] for "
+                f"world={world} in reshard layer {len(chain)}"
+            )
+        chain.append((world, ns, consumed))
+        domain = (ns - consumed) * world
+    if int(new_world) < 1:
+        raise ValueError(f"world must be >= 1, got {new_world}")
+    if drop_last:
+        num_samples = domain // int(new_world)
+    else:
+        num_samples = -(-domain // int(new_world)) if domain else 0
+    return tuple(chain), int(domain), int(num_samples)
 
 
 def stream_indices_at_generic(

@@ -7,7 +7,9 @@ from .mesh import (  # noqa: F401
     local_ranks_from_mesh,
 )
 from .sharded import (  # noqa: F401
+    make_elastic_regen_fn,
     make_regen_fn,
     make_seed_triple,
+    sharded_elastic_indices,
     sharded_epoch_indices,
 )

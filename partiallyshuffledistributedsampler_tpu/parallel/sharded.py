@@ -102,6 +102,136 @@ def make_regen_fn(
     )
 
 
+@functools.lru_cache(maxsize=None)
+def _compiled_sharded_elastic(
+    mesh: Mesh,
+    axis: str,
+    n: int,
+    window: int,
+    chain: tuple,
+    world: int,
+    num_samples: int,
+    shuffle: bool,
+    order_windows: bool,
+    partition: str,
+    rounds: int,
+):
+    """The remainder-epoch analogue of ``_compiled_sharded`` (SPEC.md §6):
+    ICI seed agreement + ordinal partition + reshard-chain composition +
+    windowed permutation, fused into ONE ``shard_map`` program — the mesh
+    consumer reshards without ever leaving the device, exactly like the
+    per-rank jitted path (ops/xla._compiled_elastic_indices, the single-rank
+    template this mirrors)."""
+    from ..ops.xla import _require_x64_for_big_n
+
+    _require_x64_for_big_n(n)  # silent uint64->uint32 demotion otherwise
+    pos_dtype = jnp.uint32 if n <= 0x7FFFFFFF else jnp.uint64
+    w_last, ns_last, c_last = chain[-1]
+    r_last = (ns_last - c_last) * w_last
+
+    def per_device(local_triple):
+        rank = jax.lax.axis_index(axis)
+        mine = local_triple[0]
+        masked = jnp.where(rank == 0, mine, jnp.zeros_like(mine))
+        agreed = jax.lax.psum(masked, axis)
+        q = core.rank_positions(
+            jnp, r_last, rank.astype(jnp.uint32), world, num_samples,
+            partition, pos_dtype,
+        )
+        pos = core.compose_remainder_chain(jnp, q, chain, partition, pos_dtype)
+        out = core.stream_indices_at_generic(
+            jnp, pos, n, window, (agreed[0], agreed[1]), agreed[2],
+            shuffle=shuffle, order_windows=order_windows, rounds=rounds,
+        )
+        return out[None, :]
+
+    from jax import shard_map
+
+    fn = shard_map(
+        per_device,
+        mesh=mesh,
+        in_specs=(P(axis, None),),
+        out_specs=P(axis, None),
+    )
+    in_sharding = NamedSharding(mesh, P(axis, None))
+    return jax.jit(fn, in_shardings=(in_sharding,))
+
+
+def make_elastic_regen_fn(
+    mesh: Mesh,
+    n: int,
+    window: int,
+    layers,
+    *,
+    axis: str = "data",
+    shuffle: bool = True,
+    drop_last: bool = False,
+    order_windows: bool = True,
+    partition: str = "strided",
+    rounds: int = core.DEFAULT_ROUNDS,
+):
+    """Compiled mesh-sharded *remainder-epoch* regen: ``(fn, num_samples)``
+    where ``fn(triple) -> [world, num_samples]`` serves exactly the epoch's
+    un-consumed stream, split across the mesh's ``world`` devices.
+
+    ``layers`` is the checkpoint cascade ``[(world, consumed), ...]``
+    outermost first (``state_dict()['elastic']['layers']`` plus the final
+    ``(old_world, offset)`` — the same shape ``reshard_from_state_dict``
+    builds); sizing/validation is ``core.elastic_chain``, shared with the
+    torch shim.  Composes into larger jitted programs like
+    :func:`make_regen_fn`.  ``num_samples == 0`` (nothing left) returns
+    ``fn = None``."""
+    world = mesh.shape[axis]
+    chain, remaining, num_samples = core.elastic_chain(
+        int(n), layers, int(world), bool(drop_last)
+    )
+    if remaining == 0:
+        return None, 0
+    fn = _compiled_sharded_elastic(
+        mesh, axis, int(n), int(window), chain, int(world), int(num_samples),
+        bool(shuffle), bool(order_windows), str(partition), int(rounds),
+    )
+    return fn, num_samples
+
+
+def sharded_elastic_indices(
+    mesh: Mesh,
+    n: int,
+    window: int,
+    seed,
+    epoch,
+    layers,
+    *,
+    axis: str = "data",
+    shuffle: bool = True,
+    drop_last: bool = False,
+    order_windows: bool = True,
+    partition: str = "strided",
+    rounds: int = core.DEFAULT_ROUNDS,
+    local_seeds=None,
+) -> jax.Array:
+    """All new ranks' remainder-epoch indices as one mesh-sharded array
+    ``[world, num_samples]`` (SPEC.md §6; empty second axis when nothing
+    remains).  Row ``r`` lives on device ``r`` and equals the torch shim's
+    ``reshard_from_state_dict(..., rank=r, backend='cpu')`` output
+    bit-exactly; seed agreement runs over ICI inside the same program."""
+    world = mesh.shape[axis]
+    fn, num_samples = make_elastic_regen_fn(
+        mesh, n, window, layers, axis=axis, shuffle=shuffle,
+        drop_last=drop_last, order_windows=order_windows,
+        partition=partition, rounds=rounds,
+    )
+    if fn is None:
+        dtype = jnp.int32 if int(n) <= 0x7FFFFFFF else jnp.int64
+        sharding = NamedSharding(mesh, P(axis, None))
+        return jax.device_put(
+            jnp.empty((world, 0), dtype=dtype), sharding
+        )
+    triple_arr = make_seed_triple(mesh, seed, epoch, axis=axis,
+                                  local_seeds=local_seeds)
+    return fn(triple_arr)
+
+
 def make_seed_triple(mesh: Mesh, seed, epoch, *, axis: str = "data",
                      local_seeds=None) -> jax.Array:
     """The mesh-sharded uint32[world, 3] (seed_lo, seed_hi, epoch) input

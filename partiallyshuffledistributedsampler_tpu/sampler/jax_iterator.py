@@ -41,13 +41,21 @@ class DeviceEpochIterator:
     regen latency is fully hidden, which is how the "<1 ms" budget becomes
     "0 ms observed" in a real loop.
 
-    ``epoch()`` costs one eager slice dispatch per step (microseconds on
-    real hardware).  Loops whose body is jittable should prefer
-    :meth:`run_epoch` (whole epoch, one dispatch) or :meth:`run_epochs`
-    (whole run, one dispatch, regen in-program) — same values, no
-    per-step dispatches at all; the noise-subtracted stall harness
-    (benchmarks/stall_native.py) measures exactly this difference.
+    ``epoch()`` costs one slice-and-unstack dispatch per ``_SPLIT_CHUNK``
+    (512) steps — NOT one per step: a single compiled program slices a
+    chunk of the epoch tensor and returns every step's batch as its own
+    device buffer, so the per-step cost is a Python yield.  Loops whose
+    body is jittable should still prefer :meth:`run_epoch` (whole epoch,
+    one dispatch) or :meth:`run_epochs` (whole run, one dispatch, regen
+    in-program) — same values, zero dispatches between steps; the
+    noise-subtracted stall harness (benchmarks/stall_native.py) measures
+    exactly this difference.
     """
+
+    #: steps per unstack program in ``epoch()``: bounds both XLA output
+    #: arity (compile time grows with outputs) and the transient second
+    #: copy of the sliced chunk
+    _SPLIT_CHUNK = 512
 
     def __init__(
         self,
@@ -70,6 +78,7 @@ class DeviceEpochIterator:
         self.num_samples, _ = core.shard_sizes(
             n, world, kwargs.get("drop_last", False)
         )
+        self.drop_last_batch = bool(drop_last_batch)
         if drop_last_batch:
             self.steps_per_epoch = self.num_samples // batch
         else:
@@ -101,17 +110,73 @@ class DeviceEpochIterator:
             for k in sorted(self._cache)[:-2]:
                 del self._cache[k]
 
+    def _build_split(self, chunk: int):
+        """One program: slice ``chunk`` whole batches starting at a traced
+        offset and unstack them — every step's batch comes back as its own
+        device buffer from a single dispatch."""
+        batch = self.batch
+
+        @jax.jit
+        def split(idx, start):
+            block = jax.lax.dynamic_slice(idx, (start,), (chunk * batch,))
+            return tuple(block.reshape(chunk, batch))
+
+        return split
+
+    def _serve_chunked(self, idx: jax.Array) -> Iterator[jax.Array]:
+        """Serve an index tensor as per-step batches: whole batches via the
+        chunked one-dispatch unstack programs, then (drop_last_batch=False)
+        the trailing partial batch.  epoch() and elastic_epoch() both route
+        here — the serve law lives once."""
+        ns = int(idx.shape[0])
+        whole = ns // self.batch
+        s = 0
+        while s < whole:
+            c = min(self._SPLIT_CHUNK, whole - s)
+            split = self._cached_runner(
+                ("split", c), lambda c=c: self._build_split(c)
+            )
+            yield from split(idx, s * self.batch)
+            s += c
+        if ns > whole * self.batch and not self.drop_last_batch:
+            yield idx[whole * self.batch:]
+
     def epoch(self, epoch: int) -> Iterator[jax.Array]:
         idx = self.epoch_array(epoch)
         if self.prefetch_next_epoch:
             self._prefetch(epoch)
-        for s in range(self.steps_per_epoch):
-            start = s * self.batch
-            size = min(self.batch, self.num_samples - start)
-            if size == self.batch:
-                yield jax.lax.dynamic_slice(idx, (start,), (self.batch,))
-            else:
-                yield idx[start:start + size]
+        yield from self._serve_chunked(idx)
+
+    def elastic_epoch_array(self, epoch: int, layers) -> jax.Array:
+        """This rank's remainder-epoch indices after a world-size change
+        (SPEC.md §6): build the iterator at the NEW ``(rank, world)`` and
+        pass the checkpoint cascade ``[(old_world, consumed), ...]``
+        outermost first.  One jitted dispatch (ops.xla.elastic_indices_jax);
+        bit-identical to the torch shim's ``reshard_from_state_dict``
+        stream for the same layers."""
+        from ..ops.xla import elastic_indices_jax
+
+        chain, remaining, ns = core.elastic_chain(
+            self.n, layers, self.world, self.kwargs.get("drop_last", False)
+        )
+        if remaining == 0:
+            dtype = jnp.int32 if self.n <= 0x7FFFFFFF else jnp.int64
+            return jnp.empty((0,), dtype)
+        return elastic_indices_jax(
+            self.n, self.window, self.seed, epoch, self.rank, self.world,
+            ns, chain,
+            shuffle=self.kwargs.get("shuffle", True),
+            order_windows=self.kwargs.get("order_windows", True),
+            partition=self.kwargs.get("partition", "strided"),
+            rounds=self.kwargs.get("rounds", core.DEFAULT_ROUNDS),
+        )
+
+    def elastic_epoch(self, epoch: int, layers) -> Iterator[jax.Array]:
+        """Per-step batches of the remainder epoch (SPEC.md §6), served
+        with the same chunked one-dispatch unstacking as :meth:`epoch`.
+        After this epoch finishes, continue with ordinary :meth:`epoch`
+        calls — the next epoch is a full epoch at the new world size."""
+        yield from self._serve_chunked(self.elastic_epoch_array(epoch, layers))
 
     def _cached_runner(self, key, build):
         """LRU (bound 4) over compiled runners: refresh recency on hit,
@@ -140,8 +205,60 @@ class DeviceEpochIterator:
 
         return over
 
+    def _tail_plan(self, on_tail: str, steps, collect: bool) -> int:
+        """Validate the scanned runners' tail-batch contract and return the
+        tail length to run in-program (0 = none).
+
+        A trailing partial batch exists only when the iterator was built
+        with ``drop_last_batch=False`` — i.e. the user asked for tail
+        service.  Scans carry a fixed batch shape, so the tail can't ride
+        the scan; it must be explicitly handled:
+
+        * ``on_tail='error'`` (default): refuse to run, naming the choices
+          — a ``drop_last_batch=False`` user never silently loses samples.
+        * ``on_tail='run'``: one extra ``step_fn(carry, tail_idx)`` step is
+          fused into the compiled program after the scan.  Incompatible
+          with ``collect=True`` (the tail's output shape can't stack with
+          the scanned ys) and with a ``steps`` cap (a partial scan
+          followed by the tail would skip the batches in between).
+        * ``on_tail='drop'``: scan whole batches only, acknowledged.
+
+        With ``drop_last_batch=True`` (the default) there is no tail by
+        construction and ``on_tail`` is irrelevant.
+        """
+        if on_tail not in ("error", "run", "drop"):
+            raise ValueError(
+                f"on_tail must be 'error', 'run' or 'drop', got {on_tail!r}"
+            )
+        tail = self.num_samples % self.batch
+        if tail == 0 or self.drop_last_batch:
+            return 0  # no tail, or the constructor opted out of it already
+        if on_tail == "error":
+            raise ValueError(
+                f"this iterator serves a trailing partial batch of {tail} "
+                f"(drop_last_batch=False) which a scanned runner cannot "
+                f"carry; pass on_tail='run' to fuse it as one extra step, "
+                f"on_tail='drop' to scan whole batches only, or use epoch()"
+            )
+        if on_tail == "drop":
+            return 0
+        if collect:
+            raise ValueError(
+                "on_tail='run' is incompatible with collect=True: the tail "
+                "step's output cannot stack with the scanned ys — use "
+                "on_tail='drop' and run the tail through epoch(), or "
+                "collect=False"
+            )
+        if steps is not None:
+            raise ValueError(
+                "on_tail='run' requires steps=None: a capped scan followed "
+                "by the tail would silently skip the batches in between"
+            )
+        return tail
+
     def run_epoch(self, epoch: int, step_fn, carry, *,
-                  steps: Optional[int] = None, collect: bool = False):
+                  steps: Optional[int] = None, collect: bool = False,
+                  on_tail: str = "error"):
         """Run an epoch's training steps in ONE compiled program.
 
         ``lax.scan`` drives ``step_fn`` over the epoch's step windows with
@@ -162,10 +279,14 @@ class DeviceEpochIterator:
         to reuse it; the cache holds the 4 most recent runners, so a
         fresh lambda per call recompiles every time.  Next-epoch prefetch
         is dispatched before the scan, exactly like ``epoch()``.
+
+        When the iterator was built with ``drop_last_batch=False`` and the
+        epoch has a trailing partial batch, ``on_tail`` decides its fate —
+        see :meth:`_tail_plan`; the default refuses loudly rather than
+        silently dropping samples the iterator contract promised to serve.
         """
-        arr = self.epoch_array(epoch)
-        if self.prefetch_next_epoch:
-            self._prefetch(epoch)
+        # validate BEFORE dispatching any device work: a bad steps/on_tail
+        # must not trigger regen dispatches or mutate the prefetch cache
         whole = self.num_samples // self.batch  # only whole batches scan
         nsteps = whole if steps is None else int(steps)
         if not 0 < nsteps <= whole:
@@ -173,23 +294,42 @@ class DeviceEpochIterator:
                 f"steps={nsteps} not in [1, {whole}]"
                 " (only whole batches can be scanned)"
             )
+        tail = self._tail_plan(on_tail, steps, collect)
+        arr = self.epoch_array(epoch)
+        if self.prefetch_next_epoch:
+            self._prefetch(epoch)
+
         def build():
             over = self._step_scan_body(step_fn, collect)
+            tail_start = whole * self.batch
 
             @jax.jit
             def runner(carry, idx):
                 c, ys = jax.lax.scan(
                     over(idx), carry, jnp.arange(nsteps, dtype=jnp.int32)
                 )
+                if tail:  # one extra fused step on the static tail slice
+                    c = step_fn(c, idx[tail_start:tail_start + tail])
                 return (c, ys) if collect else c
 
             return runner
 
-        runner = self._cached_runner((step_fn, nsteps, bool(collect)), build)
+        runner = self._cached_runner(
+            (step_fn, nsteps, bool(collect), tail), build
+        )
         return runner(carry, arr)
 
+    #: the epoch_indices_jax kwargs an in-program evaluator can honor.
+    #: ``use_pallas`` is deliberately absent: run_epochs regenerates
+    #: in-program through the pure-jnp evaluator (build_evaluator), which
+    #: never uses Pallas — values are bit-identical either way.
+    _IN_PROGRAM_KWARGS = (
+        "shuffle", "drop_last", "order_windows", "partition", "rounds",
+        "amortize",
+    )
+
     def run_epochs(self, first_epoch: int, n_epochs: int, step_fn, carry,
-                   *, collect: bool = False):
+                   *, collect: bool = False, on_tail: str = "error"):
         """Run ``n_epochs`` WHOLE epochs as one compiled program.
 
         The permutation is a pure function of the traced epoch scalar, so
@@ -204,24 +344,27 @@ class DeviceEpochIterator:
         stacked outputs have shape ``[n_epochs, steps, ...]``.  Note the
         epoch index tensor lives in HBM once per live epoch (the scan
         carries none across epochs).  The iterator's epoch cache is not
-        consulted — regen is recomputed in-program, bit-identically.
+        consulted — regen is recomputed in-program, bit-identically, and
+        every iterator kwarg except ``use_pallas`` is honored by the
+        in-program evaluator (see ``_IN_PROGRAM_KWARGS``).  Tail batches
+        follow the same ``on_tail`` contract as :meth:`run_epoch` — when
+        run, the tail step is fused after each epoch's inner scan.
         """
         whole = self.num_samples // self.batch
         if whole == 0:
             raise ValueError("batch exceeds the rank's whole-batch budget")
         if int(n_epochs) < 1:
             raise ValueError(f"n_epochs must be >= 1, got {n_epochs}")
+        tail = self._tail_plan(on_tail, None, collect)
 
         def build():
             over = self._step_scan_body(step_fn, collect)
             ev = build_evaluator(
                 self.n, self.window, self.world,
-                drop_last=self.kwargs.get("drop_last", False),
-                order_windows=self.kwargs.get("order_windows", True),
-                partition=self.kwargs.get("partition", "strided"),
-                rounds=self.kwargs.get("rounds", core.DEFAULT_ROUNDS),
-                shuffle=self.kwargs.get("shuffle", True),
+                **{k: self.kwargs[k] for k in self._IN_PROGRAM_KWARGS
+                   if k in self.kwargs},
             )
+            tail_start = whole * self.batch
             seed_lo, seed_hi = core.fold_seed(self.seed)
             base = jnp.asarray(
                 [seed_lo & 0xFFFFFFFF, seed_hi & 0xFFFFFFFF, 0,
@@ -234,9 +377,12 @@ class DeviceEpochIterator:
                 def epoch_body(c, e):
                     sv = base.at[2].set(e.astype(jnp.uint32))
                     idx = ev(sv)
-                    return jax.lax.scan(
+                    c, ys = jax.lax.scan(
                         over(idx), c, jnp.arange(whole, dtype=jnp.int32)
                     )
+                    if tail:  # fused extra step on the static tail slice
+                        c = step_fn(c, idx[tail_start:tail_start + tail])
+                    return c, ys
 
                 return jax.lax.scan(
                     epoch_body, carry,
@@ -246,7 +392,7 @@ class DeviceEpochIterator:
             return runner
 
         runner = self._cached_runner(
-            (step_fn, "epochs", int(n_epochs), bool(collect)), build
+            (step_fn, "epochs", int(n_epochs), bool(collect), tail), build
         )
         carry, ys = runner(carry, jnp.int32(first_epoch))
         return (carry, ys) if collect else carry

@@ -89,8 +89,15 @@ class PartiallyShuffleDistributedSampler(_TorchSampler):
     order_windows: also permute the order of full windows (default True).
     partition:     'strided' (torch law) or 'blocked' (contiguous shards).
     backend:       'cpu' (numpy reference), 'native' (C++ host kernel,
-                   csrc/), 'xla' (on-device JAX), or 'auto' (xla when jax
-                   imports, else native when built, else cpu).
+                   csrc/), 'xla' (on-device JAX), or 'auto' — COST-BASED:
+                   once per process 'auto' measures the host regen rate and
+                   the device dispatch+transfer line (utils/autotune) and
+                   picks whichever predicts cheaper for THIS rank's
+                   num_samples; the decision and both estimates are kept in
+                   ``_auto_cost``.  Falls back to native/cpu when jax is
+                   absent.  (Round 3 measured the old "xla when jax
+                   imports" rule costing 81 % stall at world 256 on a
+                   dispatch-expensive link where the host path stalls 20 %.)
     rounds:        swap-or-not round count (SPEC.md §2); default 24.
     use_pallas:    xla backend only — True / False / 'auto' (default): the
                    fused Pallas kernel where it wins (real TPU, int32 n),
@@ -160,15 +167,11 @@ class PartiallyShuffleDistributedSampler(_TorchSampler):
         self._consumed = 0  # samples yielded so far this epoch (auto-tracked)
         self._generation = 0  # monotonic token: which iterator owns _consumed
         self._elastic = None  # remainder-epoch state after a world-size change
+        self._auto_cost = None
         if backend == "auto":
-            try:
-                import jax  # noqa: F401
+            from ..utils.autotune import pick_backend
 
-                backend = "xla"
-            except Exception:
-                from ..ops import native as _native
-
-                backend = "native" if _native.available() else "cpu"
+            backend, self._auto_cost = pick_backend(self.num_samples)
         if backend not in ("cpu", "native", "xla"):
             raise ValueError(
                 f"backend must be 'cpu', 'native', 'xla' or 'auto', got {backend!r}"
@@ -314,43 +317,17 @@ class PartiallyShuffleDistributedSampler(_TorchSampler):
     def _compute_elastic(self, layers) -> dict:
         """Validate and describe a cascade of reshard layers (SPEC.md §6).
 
-        ``layers`` is ``[(world, consumed), ...]`` outermost first: layer 0
-        ran the base epoch at ``world_0`` ranks and each consumed
-        ``consumed_0``; every later layer ran the previous layer's remainder.
-        A single-element cascade is the ordinary one-reshard case.  Pure —
-        mutates nothing, so callers can finish all validation before
-        committing any state."""
-        chain = []
-        domain = None  # None = the base epoch; else the remaining count
-        for world, consumed in layers:
-            world, consumed = int(world), int(consumed)
-            if domain is None:
-                ns, _ = core.shard_sizes(self.n, world, self.drop_last)
-            else:
-                if world < 1:
-                    raise ValueError(f"world must be >= 1, got {world}")
-                # the remainder-epoch length law, replayed for the world
-                # that consumed it: drop_last floors (no duplicates),
-                # otherwise ceil + wrap-pad
-                if self.drop_last:
-                    ns = domain // world
-                else:
-                    ns = -(-domain // world) if domain else 0
-            if not (0 <= consumed <= ns):
-                raise ValueError(
-                    f"consumed {consumed} outside [0, {ns}] for "
-                    f"world={world} in reshard layer {len(chain)}"
-                )
-            chain.append((world, ns, consumed))
-            domain = (ns - consumed) * world
-        if self.drop_last:
-            num_samples = domain // self.num_replicas
-        else:
-            num_samples = -(-domain // self.num_replicas) if domain else 0
+        Thin wrapper over ``core.elastic_chain`` (the shared sizing law —
+        the mesh-sharded program uses the same function).  Pure — mutates
+        nothing, so callers can finish all validation before committing any
+        state."""
+        chain, remaining, num_samples = core.elastic_chain(
+            self.n, layers, self.num_replicas, self.drop_last
+        )
         return {
-            "chain": tuple(chain),
-            "remaining": int(domain),
-            "num_samples": int(num_samples),
+            "chain": chain,
+            "remaining": remaining,
+            "num_samples": num_samples,
         }
 
     def _install_elastic(self, layers) -> None:

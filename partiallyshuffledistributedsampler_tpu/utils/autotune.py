@@ -1,0 +1,132 @@
+"""Cost-based backend selection for the torch shim's ``backend='auto'``.
+
+Round 3 measured the import-based rule ("xla whenever jax imports") picking
+the wrong backend for the torch tier at high world: the xla-through-torch
+path pays a FLAT per-epoch device dispatch + device->host transfer cost
+(~128 ms through this rig's emulator tunnel) while the host path's cost
+shrinks as O(n/world) — at world 256 the xla shim stalled 81 % vs 20 % for
+the cpu backend (BENCH_r03 stall.torch).  The right backend depends on the
+per-rank shard size and on constants only the running machine knows, so
+'auto' now measures them once per process and compares predicted per-epoch
+costs:
+
+    est_host(ns)   = host_rate * ns              (O(ns) windowed regen)
+    est_device(ns) = dev_fixed + dev_rate * ns   (dispatch+sync floor plus
+                                                  device->host bytes)
+
+The device probe times a trivial jitted program and a host fetch at two
+sizes (a two-point line fit); the host probe times the real windowed regen
+on the backend the host path would actually use (native C++ when built,
+numpy otherwise).  Probes cost ~a few hundred ms on a tunnel-attached
+device, run once per process, and are skipped entirely when jax is absent.
+
+On real TPU hardware dev_fixed is ~microseconds, so 'auto' resolves to xla
+for all but trivially small shards — the flat-cost trap is an artifact of
+dispatch-expensive links, which is exactly when the host path must win.
+
+Why no chunked device->host streaming: on a link like this rig's tunnel the
+per-call FIXED cost dominates (BENCH_r03: ~128 ms/epoch flat, size nearly
+irrelevant), so splitting one transfer into K chunks multiplies the
+dominant term by K; on real hardware the transfer is microseconds and there
+is nothing worth overlapping.  The single async transfer dispatched by
+``set_epoch`` (torch_shim) is the right shape on both.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional, Tuple
+
+#: process-wide memoized model: {host_backend, host_rate_ms, dev_fixed_ms,
+#: dev_rate_ms} (rates are ms per sample)
+_MODEL: Optional[dict] = None
+
+_HOST_PROBE_N = 65536
+_DEV_PROBE_SIZES = (4096, 131072)
+_REPS = 3
+
+
+def _best(fn, reps: int = _REPS) -> float:
+    """Min wall-ms over reps (min, not mean: probes fight host jitter)."""
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, (time.perf_counter() - t0) * 1e3)
+    return best
+
+
+def _probe_host() -> Tuple[str, float]:
+    """(backend, ms per sample) for the host path this process would use."""
+    from ..ops import native as _native
+
+    if _native.available():
+        from ..ops.native import epoch_indices_native as gen
+
+        backend = "native"
+    else:
+        from ..ops.cpu import epoch_indices_np as gen
+
+        backend = "cpu"
+    gen(_HOST_PROBE_N, 512, 1, 1, 0, 1)  # warm: allocs, page-in
+    ms = _best(lambda: gen(_HOST_PROBE_N, 512, 1, 1, 0, 1))
+    return backend, ms / _HOST_PROBE_N
+
+
+def _probe_device() -> Tuple[float, float]:
+    """(fixed ms, ms per sample) for dispatch + device->host fetch, from a
+    two-point line over trivial programs (kernel compute is sub-ms at these
+    sizes and irrelevant next to the link costs being measured)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    costs = []
+    for m in _DEV_PROBE_SIZES:
+        f = jax.jit(lambda e, m=m: jnp.full((m,), e, jnp.int32))
+        np.asarray(f(0))  # compile + warm the transfer path
+        costs.append(_best(lambda f=f: np.asarray(f(1))))
+    rate = (costs[1] - costs[0]) / (_DEV_PROBE_SIZES[1] - _DEV_PROBE_SIZES[0])
+    rate = max(rate, 0.0)  # noise can invert the two points
+    fixed = max(costs[0] - rate * _DEV_PROBE_SIZES[0], 0.0)
+    return fixed, rate
+
+
+def cost_model(force: bool = False) -> Optional[dict]:
+    """The measured constants, memoized per process; None when jax is
+    unavailable (the host path is then the only choice)."""
+    global _MODEL
+    if _MODEL is not None and not force:
+        return _MODEL
+    try:
+        import jax  # noqa: F401
+    except Exception:
+        return None
+    host_backend, host_rate = _probe_host()
+    dev_fixed, dev_rate = _probe_device()
+    _MODEL = {
+        "host_backend": host_backend,
+        "host_rate_ms": host_rate,
+        "dev_fixed_ms": dev_fixed,
+        "dev_rate_ms": dev_rate,
+    }
+    return _MODEL
+
+
+def pick_backend(num_samples: int) -> Tuple[str, Optional[dict]]:
+    """Resolve 'auto' for a rank generating ``num_samples`` indices/epoch.
+
+    Returns ``(backend, info)``; ``info`` carries the model and both
+    estimates for observability (the shim stores it as
+    ``_auto_cost``)."""
+    model = cost_model()
+    if model is None:  # no jax: native when built, else numpy
+        from ..ops import native as _native
+
+        return ("native" if _native.available() else "cpu"), None
+    est_host = model["host_rate_ms"] * num_samples
+    est_dev = model["dev_fixed_ms"] + model["dev_rate_ms"] * num_samples
+    backend = "xla" if est_dev < est_host else model["host_backend"]
+    info = dict(model, est_host_ms=est_host, est_device_ms=est_dev,
+                num_samples=num_samples, picked=backend)
+    return backend, info

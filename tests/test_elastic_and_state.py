@@ -605,3 +605,12 @@ def test_identity_from_mesh_interleaved_assignment(monkeypatch):
     monkeypatch.setattr(jax, "process_index", lambda: 7)
     with pytest.raises(ValueError, match="owns no devices"):
         mesh_mod.identity_from_mesh(m)
+
+
+def test_elastic_chain_empty_layers_named_error():
+    import pytest
+
+    from partiallyshuffledistributedsampler_tpu.ops import core
+
+    with pytest.raises(ValueError, match="empty"):
+        core.elastic_chain(100, [], 4, False)

@@ -183,6 +183,55 @@ def test_cpu_xla_parity(cfg):
     np.testing.assert_array_equal(got, ref)
 
 
+# --------------------------------------------------------------- fold_seed
+@settings(max_examples=40, **SETTINGS)
+@given(seed=st.integers(-(2**80), 2**80))
+def test_fold_seed_wide_and_negative(seed):
+    """SPEC §1 folding is airtight for any int: halves land in uint32
+    range, bits >= 64 are dropped, negatives wrap two's-complement —
+    and the fold, not the raw int, is what indexes."""
+    lo, hi = core.fold_seed(seed)
+    assert 0 <= lo <= 0xFFFFFFFF and 0 <= hi <= 0xFFFFFFFF
+    assert lo == seed & 0xFFFFFFFF
+    assert hi == (seed >> 32) & 0xFFFFFFFF
+    np.testing.assert_array_equal(
+        cpu.epoch_indices_np(64, 8, seed, 0, 0, 1),
+        cpu.epoch_indices_np(64, 8, seed % 2**64, 0, 0, 1),
+    )
+
+
+def test_fold_seed_tuple_validation():
+    # a hand-split (lo, hi) pair must be shape- and range-checked rather
+    # than wrapping silently at the later dtype cast
+    assert core.fold_seed((3, 4)) == (3, 4)
+    with pytest.raises(ValueError, match="length"):
+        core.fold_seed((1, 2, 3))
+    with pytest.raises(ValueError, match="uint32"):
+        core.fold_seed((2**32, 0))
+    with pytest.raises(ValueError, match="uint32"):
+        core.fold_seed((0, -1))
+
+
+def test_fold_seed_traced_scalar_path():
+    # a traced uint32 seed flows through (hi = 0) and matches the concrete
+    # fold of the same value
+    import jax
+    import jax.numpy as jnp
+
+    from partiallyshuffledistributedsampler_tpu.ops.xla import (
+        epoch_indices_jax,
+    )
+
+    @jax.jit
+    def f(s):
+        return epoch_indices_jax(64, 8, s, 0, 0, 1)
+
+    np.testing.assert_array_equal(
+        np.asarray(f(jnp.uint32(1234))),
+        cpu.epoch_indices_np(64, 8, 1234, 0, 0, 1),
+    )
+
+
 from conftest import assert_exactly_once  # shared SPEC §6 law assertion
 
 

@@ -192,3 +192,47 @@ def test_shard_index_mode():
     s.set_epoch(0)
     ids = list(s)
     assert len(ids) == 128 and all(0 <= i < 1024 for i in ids)
+
+
+def test_auto_backend_is_cost_based(monkeypatch):
+    # 'auto' compares predicted per-epoch costs (BENCH_r03: the import-based
+    # rule stalled 81% at world 256 where the host path stalls 20%); inject
+    # a model with an expensive device link and check both sides of the
+    # crossover, plus that the sampler records the decision
+    from partiallyshuffledistributedsampler_tpu.utils import autotune
+
+    model = {"host_backend": "cpu", "host_rate_ms": 0.001,
+             "dev_fixed_ms": 100.0, "dev_rate_ms": 0.0}
+    monkeypatch.setattr(autotune, "_MODEL", model)
+    b, info = autotune.pick_backend(1_000)       # host: 1 ms < 100 ms
+    assert b == "cpu" and info["picked"] == "cpu"
+    b2, info2 = autotune.pick_backend(10**9)     # host: 1e6 ms > 100 ms
+    assert b2 == "xla" and info2["est_device_ms"] < info2["est_host_ms"]
+
+    s = make(n=2000, backend="auto")
+    assert s.backend == "cpu"
+    assert s._auto_cost["num_samples"] == s.num_samples
+    # pinned backends never probe
+    assert make(n=2000, backend="cpu")._auto_cost is None
+
+
+def test_auto_backend_without_jax(monkeypatch):
+    # when jax can't import, 'auto' falls back host-side (native if built,
+    # else cpu) without touching the cost model
+    import builtins
+
+    from partiallyshuffledistributedsampler_tpu.ops import native as _native
+    from partiallyshuffledistributedsampler_tpu.utils import autotune
+
+    monkeypatch.setattr(autotune, "_MODEL", None)
+    real_import = builtins.__import__
+
+    def no_jax(name, *a, **k):
+        if name == "jax" or name.startswith("jax."):
+            raise ImportError("jax disabled for this test")
+        return real_import(name, *a, **k)
+
+    monkeypatch.setattr(builtins, "__import__", no_jax)
+    s = make(n=2000, backend="auto")
+    assert s.backend == ("native" if _native.available() else "cpu")
+    assert s._auto_cost is None
