@@ -185,7 +185,9 @@ def make_elastic_regen_fn(
     chain, remaining, num_samples = core.elastic_chain(
         int(n), layers, int(world), bool(drop_last)
     )
-    if remaining == 0:
+    if num_samples == 0:
+        # nothing left, or drop_last floors 0 < remaining < world to zero
+        # per-rank samples — either way there is no program to run
         return None, 0
     fn = _compiled_sharded_elastic(
         mesh, axis, int(n), int(window), chain, int(world), int(num_samples),

@@ -286,15 +286,19 @@ class DeviceEpochIterator:
         silently dropping samples the iterator contract promised to serve.
         """
         # validate BEFORE dispatching any device work: a bad steps/on_tail
-        # must not trigger regen dispatches or mutate the prefetch cache
+        # must not trigger regen dispatches or mutate the prefetch cache.
+        # _tail_plan goes first so a tail-only epoch (num_samples < batch,
+        # drop_last_batch=False) gets the tail-contract guidance, and with
+        # on_tail='run' such an epoch is runnable: a zero-length scan plus
+        # the fused tail step.
         whole = self.num_samples // self.batch  # only whole batches scan
+        tail = self._tail_plan(on_tail, steps, collect)
         nsteps = whole if steps is None else int(steps)
-        if not 0 < nsteps <= whole:
+        if not (0 < nsteps <= whole or (nsteps == 0 and tail)):
             raise ValueError(
                 f"steps={nsteps} not in [1, {whole}]"
                 " (only whole batches can be scanned)"
             )
-        tail = self._tail_plan(on_tail, steps, collect)
         arr = self.epoch_array(epoch)
         if self.prefetch_next_epoch:
             self._prefetch(epoch)
@@ -305,9 +309,12 @@ class DeviceEpochIterator:
 
             @jax.jit
             def runner(carry, idx):
-                c, ys = jax.lax.scan(
-                    over(idx), carry, jnp.arange(nsteps, dtype=jnp.int32)
-                )
+                if nsteps:  # static: a tail-only epoch scans nothing
+                    c, ys = jax.lax.scan(
+                        over(idx), carry, jnp.arange(nsteps, dtype=jnp.int32)
+                    )
+                else:
+                    c, ys = carry, None
                 if tail:  # one extra fused step on the static tail slice
                     c = step_fn(c, idx[tail_start:tail_start + tail])
                 return (c, ys) if collect else c
@@ -351,11 +358,11 @@ class DeviceEpochIterator:
         run, the tail step is fused after each epoch's inner scan.
         """
         whole = self.num_samples // self.batch
-        if whole == 0:
+        tail = self._tail_plan(on_tail, None, collect)
+        if whole == 0 and not tail:
             raise ValueError("batch exceeds the rank's whole-batch budget")
         if int(n_epochs) < 1:
             raise ValueError(f"n_epochs must be >= 1, got {n_epochs}")
-        tail = self._tail_plan(on_tail, None, collect)
 
         def build():
             over = self._step_scan_body(step_fn, collect)
@@ -377,9 +384,12 @@ class DeviceEpochIterator:
                 def epoch_body(c, e):
                     sv = base.at[2].set(e.astype(jnp.uint32))
                     idx = ev(sv)
-                    c, ys = jax.lax.scan(
-                        over(idx), c, jnp.arange(whole, dtype=jnp.int32)
-                    )
+                    if whole:  # static: a tail-only epoch scans nothing
+                        c, ys = jax.lax.scan(
+                            over(idx), c, jnp.arange(whole, dtype=jnp.int32)
+                        )
+                    else:
+                        ys = None
                     if tail:  # fused extra step on the static tail slice
                         c = step_fn(c, idx[tail_start:tail_start + tail])
                     return c, ys

@@ -6,6 +6,7 @@ import pytest
 
 from partiallyshuffledistributedsampler_tpu.ops import cpu
 from partiallyshuffledistributedsampler_tpu.ops.pallas_kernel import (
+    compact_kex_applicable,
     epoch_indices_pallas,
 )
 
@@ -87,16 +88,56 @@ def test_amortized_call_asserts_num_samples_contract():
 
 def test_explicit_pallas_pin_honored_when_compact_inapplicable():
     # m=2 can't be expanded in-kernel; an explicit use_pallas=True must
-    # still run a Pallas kernel (the general one), bit-identically —
-    # never a silent demotion to the XLA evaluator
+    # still run a Pallas kernel (the general one), bit-identically — never
+    # a silent demotion to the XLA evaluator — and must WARN that it got
+    # the ~5x general kernel (round-3 verdict: the downgrade was silent)
     from partiallyshuffledistributedsampler_tpu.ops.xla import (
         epoch_indices_jax,
     )
 
     ref = cpu.epoch_indices_np(2048, 512, 3, 1, 7, 256)
-    got = np.asarray(epoch_indices_jax(2048, 512, 3, 1, 7, 256,
-                                       use_pallas=True))
+    with pytest.warns(RuntimeWarning, match="GENERAL fused kernel"):
+        got = np.asarray(epoch_indices_jax(2048, 512, 3, 1, 7, 256,
+                                           use_pallas=True))
     np.testing.assert_array_equal(got, ref)
+
+
+@pytest.mark.parametrize(
+    "n,window,world",
+    [
+        (4096, 16, 4),    # m=4 < 8: select-chain expansion too costly
+        (4096, 192, 8),   # m=24: neither 128 | m nor m | 128
+    ],
+)
+def test_coverage_hole_shape_classes(n, window, world, monkeypatch):
+    """Per-shape-class contract for the amortized kernel's coverage holes:
+    explicit pin -> general kernel + RuntimeWarning; 'auto' on a TPU
+    backend -> the XLA amortized evaluator, silently (it is the measured
+    next-best there).  Values bit-identical in every case."""
+    import warnings
+
+    import jax
+
+    from partiallyshuffledistributedsampler_tpu.ops import xla as x
+
+    assert not compact_kex_applicable(window, world)
+    ref = cpu.epoch_indices_np(n, window, 5, 2, 1, world)
+
+    with pytest.warns(RuntimeWarning, match="GENERAL fused kernel"):
+        got_pin = np.asarray(
+            x.epoch_indices_jax(n, window, 5, 2, 1, world, use_pallas=True)
+        )
+    np.testing.assert_array_equal(got_pin, ref)
+
+    # force the 'auto' TPU-backend branch without a TPU: the hole routes
+    # to use_pallas=False before any kernel build, so no Mosaic compile
+    monkeypatch.setattr(x.jax, "default_backend", lambda: "tpu")
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # any warning would fail the test
+        got_auto = np.asarray(
+            x.epoch_indices_jax(n, window, 5, 2, 1, world, use_pallas="auto")
+        )
+    np.testing.assert_array_equal(got_auto, ref)
 
 
 @pytest.mark.parametrize(

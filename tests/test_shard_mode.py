@@ -162,3 +162,40 @@ def test_shard_sampler_to_samples_pipeline():
     for v in base:
         pool.remove(v)
     assert len(pool) == -(-num_shards // world) * world - num_shards
+
+
+def test_batched_expansion_matches_per_shard_loop():
+    # the size-class batching must be bit-identical to the per-shard
+    # evaluation for every shuffle mode, mixed sizes, any id order
+    rng = np.random.default_rng(7)
+    sizes = rng.integers(0, 90, size=200).tolist()
+    ids = rng.permutation(200)[:120].tolist()
+    for wss in (True, False, 7):
+        got = expand_shard_indices_np(
+            ids, sizes, seed=11, epoch=3, within_shard_shuffle=wss
+        )
+        ref_parts = [
+            int(np.concatenate([[0], np.cumsum(sizes)[:-1]])[s])
+            + shard_sample_order(s, sizes[s], seed=11, epoch=3,
+                                 within_shard_shuffle=wss)
+            for s in ids if sizes[s]
+        ]
+        ref = (np.concatenate(ref_parts) if ref_parts
+               else np.empty(0, np.int64))
+        np.testing.assert_array_equal(got, ref)
+        # generator path streams the same values in the same order
+        assert list(expand_shard_indices(
+            ids, sizes, seed=11, epoch=3, within_shard_shuffle=wss
+        )) == got.tolist()
+
+
+def test_batched_expansion_wide_seed():
+    # the vectorized key fold must match fold_seed(shard_seed(...)) for
+    # seeds wider than 64 bits too (fold commutes with the XOR)
+    wide = (1 << 77) + 12345
+    got = expand_shard_indices_np([3, 1], [8, 8, 8, 8], seed=wide, epoch=2)
+    ref = np.concatenate([
+        24 + shard_sample_order(3, 8, seed=wide, epoch=2),
+        8 + shard_sample_order(1, 8, seed=wide, epoch=2),
+    ])
+    np.testing.assert_array_equal(got, ref)

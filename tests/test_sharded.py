@@ -200,3 +200,18 @@ def test_sharded_elastic_empty_remainder(mesh8):
     ns0, _ = _core.shard_sizes(80, 4, False)
     out = sharded_elastic_indices(mesh8, 80, 16, 0, 0, [(4, ns0)])
     assert out.shape == (8, 0)
+
+
+def test_sharded_elastic_drop_last_floors_to_none(mesh8):
+    # drop_last with 0 < remaining < world: num_samples floors to 0 and the
+    # factory must return fn=None (the documented nothing-to-run contract)
+    from partiallyshuffledistributedsampler_tpu.ops import core as _core
+    from partiallyshuffledistributedsampler_tpu.parallel import (
+        make_elastic_regen_fn,
+    )
+
+    ns0, _ = _core.shard_sizes(80, 4, True)  # 20 per rank
+    fn, ns = make_elastic_regen_fn(mesh8, 80, 16, [(4, ns0 - 1)],
+                                   drop_last=True)
+    # remaining = 4, world = 8 -> floor(4/8) = 0 per rank
+    assert fn is None and ns == 0

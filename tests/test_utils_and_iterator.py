@@ -371,3 +371,21 @@ def test_device_iterator_elastic_epoch():
     # nothing left -> empty iteration, not an error
     ns0 = it.num_samples  # n=1000 world=2 -> 500
     assert list(it.elastic_epoch(4, [(2, ns0)])) == []
+
+
+def test_run_epoch_tail_only_epoch():
+    # num_samples < batch with drop_last_batch=False: zero whole batches,
+    # one tail — on_tail='run' must serve it (zero-length scan + fused
+    # tail step); the default must give the tail-contract guidance, not a
+    # bare steps error
+    it = DeviceEpochIterator(n=50, window=16, batch=64, world=1,
+                             drop_last_batch=False)
+    step = lambda c, i: c + i.sum()
+    with pytest.raises(ValueError, match="on_tail"):
+        it.run_epoch(0, step, jnp.int32(0))
+    got = it.run_epoch(0, step, jnp.int32(0), on_tail="run")
+    ref = int(np.asarray(it.epoch_array(0)).sum())
+    assert int(got) == ref
+    got2 = it.run_epochs(0, 2, step, jnp.int32(0), on_tail="run")
+    ref2 = ref + int(np.asarray(it.epoch_array(1)).sum())
+    assert int(got2) == ref2
