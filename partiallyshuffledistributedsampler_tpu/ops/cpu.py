@@ -85,3 +85,48 @@ def full_epoch_stream_np(
         np, p, n, window, ek, order_windows=order_windows, rounds=rounds,
         pos_dtype=pos_dtype,
     ).astype(out_dtype)
+
+
+def elastic_indices_np(
+    n: int,
+    window: int,
+    seed,
+    epoch: int,
+    rank: int,
+    world: int,
+    layers,
+    *,
+    shuffle: bool = True,
+    drop_last: bool = False,
+    order_windows: bool = True,
+    partition: str = "strided",
+    rounds: int = core.DEFAULT_ROUNDS,
+) -> np.ndarray:
+    """Rank's elastic remainder-epoch indices on the host (SPEC.md §6/§6.1)
+    — the numpy counterpart of ``ops.xla.elastic_indices_jax`` and the ONE
+    reference derivation of the remainder law: the torch shim's host
+    backends, the mesh-program tests and the driver dryrun all call here
+    rather than re-composing rank_positions/compose_remainder_chain/
+    stream_indices_at_generic by hand.
+
+    ``layers`` is the checkpoint cascade ``[(world, consumed), ...]``
+    outermost first; sizing/validation via ``core.elastic_chain``.
+    """
+    chain, remaining, num_samples = core.elastic_chain(
+        n, layers, world, drop_last
+    )
+    out_dtype = np.int32 if n <= 0x7FFFFFFF else np.int64
+    if remaining == 0 or num_samples == 0:
+        return np.empty(0, dtype=out_dtype)
+    pos_dtype = np.uint32 if n <= 0x7FFFFFFF else np.uint64
+    q = core.rank_positions(
+        np, remaining, rank, world, num_samples, partition, pos_dtype
+    )
+    pos = core.compose_remainder_chain(np, q, chain, partition, pos_dtype)
+    return np.asarray(
+        core.stream_indices_at_generic(
+            np, pos, n, window, seed, epoch,
+            shuffle=shuffle, order_windows=order_windows, rounds=rounds,
+        ),
+        dtype=out_dtype,
+    )

@@ -363,24 +363,15 @@ class PartiallyShuffleDistributedSampler(_TorchSampler):
                 )
             )
         else:
-            pos_dtype = np.uint32 if self.n <= 0x7FFFFFFF else np.uint64
-            # the ordinal partition over the remainder IS the §4
-            # rank-partition law with n = R — one implementation, not a
-            # hand-rolled copy
-            q = core.rank_positions(
-                np, el["remaining"], self.rank, self.num_replicas, ns,
-                self.partition, pos_dtype,
-            )
-            pos = core.compose_remainder_chain(
-                np, q, el["chain"], self.partition, pos_dtype
-            )
-            arr = np.asarray(
-                core.stream_indices_at_generic(
-                    np, pos, self.n, self.window, self.seed, epoch,
-                    shuffle=self.shuffle, order_windows=self.order_windows,
-                    rounds=self.rounds,
-                ),
-                dtype=out_dtype,
+            from ..ops.cpu import elastic_indices_np
+
+            arr = elastic_indices_np(
+                self.n, self.window, self.seed, epoch, self.rank,
+                self.num_replicas,
+                [(w, c) for (w, _ns, c) in el["chain"]],
+                shuffle=self.shuffle, drop_last=self.drop_last,
+                order_windows=self.order_windows, partition=self.partition,
+                rounds=self.rounds,
             )
         # the cache is shared across __iter__ calls and public
         # epoch_indices(); hand out a read-only view so in-place caller

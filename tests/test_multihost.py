@@ -46,7 +46,6 @@ _WORKER = textwrap.dedent("""
     # elastic remainder epoch across process boundaries: the same fused
     # shard_map program (seed agreement + chain composition + permutation)
     # must serve every new rank its cpu-reshard stream bit-exactly
-    from partiallyshuffledistributedsampler_tpu.ops import core
     from partiallyshuffledistributedsampler_tpu.parallel import (
         sharded_elastic_indices)
 
@@ -59,16 +58,10 @@ _WORKER = textwrap.dedent("""
     layers = [(3, 500)]
     eout = sharded_elastic_indices(mesh, n, w, None, None, layers,
                                    local_seeds=local)
-    chain, remaining, ns = core.elastic_chain(n, layers, 8, False)
     for shard in eout.addressable_shards:
         r = shard.index[0].start or 0
-        q = core.rank_positions(np, remaining, r, 8, ns, "strided",
-                                np.uint32)
-        pos = core.compose_remainder_chain(np, q, chain, "strided",
-                                           np.uint32)
-        ref = core.stream_indices_at_generic(np, pos, n, w, seed, epoch)
-        np.testing.assert_array_equal(np.asarray(shard.data)[0],
-                                      np.asarray(ref))
+        ref = cpu.elastic_indices_np(n, w, seed, epoch, r, 8, layers)
+        np.testing.assert_array_equal(np.asarray(shard.data)[0], ref)
 
     print(f"MULTIHOST_OK pid={pid} rows=" +
           ",".join(str(s.index[0].start or 0) for s in out.addressable_shards))

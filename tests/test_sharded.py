@@ -173,7 +173,7 @@ def test_sharded_elastic_cascade_and_agreement(mesh8):
 
     n, w = 2000, 32
     layers = [(3, 50), (5, 40)]
-    chain, remaining, ns = core.elastic_chain(n, layers, 8, False)
+    _chain, _remaining, ns = core.elastic_chain(n, layers, 8, False)
     local = np.stack(
         [[7, 0, 9]] + [[1000 + r, r, 77 + r] for r in range(1, 8)]
     ).astype(np.uint32)
@@ -183,12 +183,10 @@ def test_sharded_elastic_cascade_and_agreement(mesh8):
     )
     assert out.shape == (8, ns)
     for r in range(8):
-        q = core.rank_positions(np, remaining, r, 8, ns, "strided",
-                                np.uint32)
-        pos = core.compose_remainder_chain(np, q, chain, "strided",
-                                           np.uint32)
-        ref = core.stream_indices_at_generic(np, pos, n, w, 7, 9)
-        np.testing.assert_array_equal(out[r], np.asarray(ref))
+        # rank 0's (seed=7, epoch=9) must have won the ICI agreement
+        np.testing.assert_array_equal(
+            out[r], cpu.elastic_indices_np(n, w, 7, 9, r, 8, layers)
+        )
 
 
 def test_sharded_elastic_empty_remainder(mesh8):
