@@ -4,6 +4,7 @@ from .jax_iterator import DeviceEpochIterator, batch_index_window  # noqa: F401
 from .shard_mode import (  # noqa: F401
     PartialShuffleShardSampler,
     expand_shard_indices,
+    expand_shard_indices_jax,
     expand_shard_indices_np,
     shard_sample_order,
     shard_seed,

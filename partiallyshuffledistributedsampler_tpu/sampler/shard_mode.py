@@ -16,6 +16,7 @@ streams are checkpoint-stable across builds.
 
 from __future__ import annotations
 
+import functools
 from typing import Iterable, Iterator, Optional, Sequence, Union
 
 import numpy as np
@@ -95,48 +96,61 @@ def shard_sample_order(
     ).astype(np.int64)
 
 
-def _shard_epoch_keys(sid_arr: np.ndarray, seed: int):
+def _shard_epoch_keys(xp, sid_arr, seed: int):
     """Vectorized §1 fold of ``shard_seed(seed, sid)`` for a shard-id
-    vector: ``(lo, hi)`` uint32 arrays.
+    vector: ``(lo, hi)`` uint32 arrays — backend-generic (numpy or jnp).
 
     Folding commutes with XOR bit-for-bit, so
     ``fold(seed ^ K) == (fold_lo(seed) ^ K_lo, fold_hi(seed) ^ K_hi)`` with
-    ``K = _SHARD_SEED_STRIDE + sid`` (< 2**64 for any realistic sid) —
-    bit-identical to ``core.fold_seed(shard_seed(seed, sid))`` per shard,
-    asserted by the batch-vs-loop parity test."""
-    lo0, hi0 = core.fold_seed(int(seed))
-    k = np.uint64(_SHARD_SEED_STRIDE) + sid_arr.astype(np.uint64)
-    lo = np.uint32(lo0) ^ (k & np.uint64(0xFFFFFFFF)).astype(np.uint32)
-    hi = np.uint32(hi0) ^ (k >> np.uint64(32)).astype(np.uint32)
+    ``K = _SHARD_SEED_STRIDE + sid``.  The 64-bit add is carried in uint32
+    halves (``sum_lo < sid`` detects the wrap) so the jnp path needs no
+    x64 — bit-identical to ``core.fold_seed(shard_seed(seed, sid))`` per
+    shard for any ``sid < 2**32``, asserted by the batch-vs-loop parity
+    test."""
+    lo0, hi0 = core.fold_seed(seed)  # int, (lo, hi) pair, or traced scalar
+    stride_lo = _u32c(xp, _SHARD_SEED_STRIDE & 0xFFFFFFFF)
+    stride_hi = _u32c(xp, (_SHARD_SEED_STRIDE >> 32) & 0xFFFFFFFF)
+    sid_u = xp.asarray(sid_arr).astype(xp.uint32)
+    sum_lo = stride_lo + sid_u  # wraps mod 2^32
+    carry = (sum_lo < sid_u).astype(xp.uint32)
+    lo = xp.asarray(lo0).astype(xp.uint32) ^ sum_lo
+    hi = xp.asarray(hi0).astype(xp.uint32) ^ (stride_hi + carry)
     return lo, hi
 
 
+def _u32c(xp, v: int):
+    return xp.asarray(np.uint32(v))
+
+
 def _batched_shard_orders(
-    sid_arr: np.ndarray,
+    sid_arr,
     m: int,
     *,
     seed: int,
     epoch: int,
     within_shard_shuffle: Union[bool, int],
     rounds: int,
+    xp=np,
 ) -> np.ndarray:
     """Within-shard orders for a whole SIZE CLASS at once: ``[S, m]`` from
     one vectorized §3 program (the swap-or-not rounds are elementwise, so
     per-shard keys broadcast as a ``[S, 1]`` column against the shared
     ``[1, m]`` position row).  Row ``i`` is bit-identical to
-    ``shard_sample_order(sid_arr[i], m, ...)``."""
+    ``shard_sample_order(sid_arr[i], m, ...)``.  Backend-generic: ``xp``
+    is numpy (host) or jnp (the device expansion, where it is jitted)."""
     w = _within_shard_window(m, within_shard_shuffle)
+    out_dtype = np.int64 if xp is np else xp.int32
     if w <= 1:
-        return np.broadcast_to(
-            np.arange(m, dtype=np.int64), (len(sid_arr), m)
+        return xp.broadcast_to(
+            xp.arange(m, dtype=out_dtype), (len(sid_arr), m)
         )
-    lo, hi = _shard_epoch_keys(sid_arr, seed)
-    ek = core.derive_epoch_key(np, (lo[:, None], hi[:, None]), epoch)
-    p = np.arange(m, dtype=np.uint32)[None, :]
+    lo, hi = _shard_epoch_keys(xp, sid_arr, seed)
+    ek = core.derive_epoch_key(xp, (lo[:, None], hi[:, None]), epoch)
+    p = xp.arange(m, dtype=xp.uint32)[None, :]
     return core.windowed_perm(
-        np, p, m, w, ek,
+        xp, p, m, w, ek,
         order_windows=(within_shard_shuffle is True), rounds=rounds,
-    ).astype(np.int64)
+    ).astype(out_dtype)
 
 
 #: shards per batch block in the streaming expander — bounds transient
@@ -241,6 +255,117 @@ def expand_shard_indices_np(
                        + np.arange(m, dtype=np.int64))
                 out[pos.ravel()] = glob.ravel()
     return out
+
+
+@functools.lru_cache(maxsize=None)
+def _class_expand_jit(m: int, full_shuffle: bool, w_int: int, rounds: int,
+                      big: bool):
+    """One jitted device program per (size class, static knobs): within-
+    shard orders for the class plus the global offset add.  ``seed`` and
+    ``epoch`` are traced uint32 scalars, so reseeds and new epochs reuse
+    the executable.  The shuffle mode rides as TWO key fields
+    (full_shuffle, w_int): ``True == 1`` hash-collides in a single field
+    and lru_cache would silently serve the wrong program."""
+    import jax
+    import jax.numpy as jnp
+
+    wss = True if full_shuffle else w_int  # w_int == 0 means sequential
+    dtype = jnp.int64 if big else jnp.int32
+
+    @jax.jit
+    def f(sid_sub, off_sub, seed_lo, seed_hi, epoch_u32):
+        orders = _batched_shard_orders(
+            sid_sub, m, seed=(seed_lo, seed_hi), epoch=epoch_u32,
+            within_shard_shuffle=wss, rounds=rounds, xp=jnp,
+        )
+        return off_sub.astype(dtype)[:, None] + orders.astype(dtype)
+
+    return f
+
+
+def expand_shard_indices_jax(
+    shard_ids: Sequence[int],
+    shard_sizes: Sequence[int],
+    *,
+    seed: int = 0,
+    epoch: int = 0,
+    within_shard_shuffle: Union[bool, int] = True,
+    rounds: int = core.DEFAULT_ROUNDS,
+):
+    """Device-side expansion — same law, same order, same values as
+    :func:`expand_shard_indices_np`, with each size class's batched §3
+    program jitted on the accelerator and the result left in HBM for a
+    JAX input pipeline.
+
+    This is where the full in-shard shuffle stops being host-bound: at
+    config-3/4 scale (100k shards x 1000 samples = 1e8 indices) the host
+    expansion is permutation-bound at ~51 s/epoch (BASELINE.md) while the
+    device runs the identical uint32 program in device-rate time, with
+    the output resident in HBM.  Grouping by size class stays on the
+    host (shard sizes are metadata); one jitted program per class size,
+    reused across seeds and epochs (both traced).  Uniform sizes ship
+    only shard ids + offsets; mixed sizes additionally ship one
+    stream-order permutation per call and pay one device gather.
+    Datasets with thousands of DISTINCT shard sizes compile one program
+    per size (static shapes) — prefer the host expansion there.  Totals
+    >= 2^31 need ``enable_big_index_space()``.
+    """
+    import jax.numpy as jnp
+
+    from ..ops.xla import _require_x64_for_big_n
+
+    sizes = np.asarray(shard_sizes, dtype=np.int64)
+    offsets = np.concatenate([[0], np.cumsum(sizes)[:-1]])
+    sids = np.asarray(list(shard_ids), dtype=np.int64)
+    total_space = int(sizes.sum())
+    big = total_space > 0x7FFFFFFF
+    if big:
+        _require_x64_for_big_n(total_space)
+    dtype = jnp.int64 if big else jnp.int32
+    if sids.size == 0:
+        return jnp.empty(0, dtype=dtype)
+    m_of = sizes[sids]
+    out_starts = np.concatenate([[0], np.cumsum(m_of)[:-1]])
+    total = int(m_of.sum())
+    seed_lo, seed_hi = core.fold_seed(int(seed))
+    traced = (np.uint32(seed_lo), np.uint32(seed_hi),
+              np.uint32(int(epoch) & 0xFFFFFFFF))
+    groups = [(m, members) for m, members in _size_class_members(m_of)
+              if m > 0]
+    # normalize the shuffle mode exactly like _within_shard_window: `is
+    # True` means full shuffle; anything else (False, int, np.integer) is
+    # a window int — a bool() coercion here would turn np.int64(3) into a
+    # full shuffle and silently diverge from the host path
+    full = within_shard_shuffle is True
+    w_int = 0 if full else int(within_shard_shuffle)
+    off_dtype = np.int64 if big else np.int32  # avoid silent x64 downcasts
+
+    def run_class(m, members):
+        f = _class_expand_jit(m, full, w_int, int(rounds), big)
+        return f(sids[members].astype(np.uint32),
+                 offsets[sids[members]].astype(off_dtype), *traced)
+
+    if len(groups) == 1 and groups[0][1].shape[0] == sids.size:
+        # uniform sizes: one program, the reshape IS the stream order
+        return run_class(*groups[0]).reshape(-1)
+    # mixed sizes: concatenate per-class results on device, then ONE
+    # gather through a host-built stream-order permutation (a per-class
+    # scatter would copy the whole output buffer once per class)
+    parts = [run_class(m, members).reshape(-1) for m, members in groups]
+    cat = jnp.concatenate(parts) if parts else jnp.empty(0, dtype=dtype)
+    # zero-size shards occupy no output width, so the nonzero groups tile
+    # [0, total) exactly and the permutation below is total
+    perm = np.empty(total, dtype=off_dtype)
+    base = 0
+    for m, members in groups:
+        k = len(members)
+        ar = np.arange(m, dtype=np.int64)
+        stream_pos = (out_starts[members][:, None] + ar).ravel()
+        cat_pos = (base + np.arange(k, dtype=np.int64)[:, None] * m
+                   + ar).ravel()
+        perm[stream_pos] = cat_pos
+        base += k * m
+    return cat[jnp.asarray(perm)]
 
 
 def expand_shard_indices(

@@ -199,3 +199,35 @@ def test_batched_expansion_wide_seed():
         8 + shard_sample_order(1, 8, seed=wide, epoch=2),
     ])
     np.testing.assert_array_equal(got, ref)
+
+
+def test_device_expansion_matches_host():
+    # expand_shard_indices_jax runs the identical uint32 program on the
+    # device: bit-identical to the host expansion for every shuffle mode,
+    # uniform and mixed sizes, and reusable across epochs (epoch traced)
+    from partiallyshuffledistributedsampler_tpu.sampler import (
+        expand_shard_indices_jax,
+    )
+
+    rng = np.random.default_rng(3)
+    uniform = [40] * 60
+    mixed = rng.integers(0, 50, size=60).tolist()
+    ids = rng.permutation(60)[:45].tolist()
+    # True then 1 in sequence: True == 1 hash-collides, so a single-field
+    # program cache would serve the full-shuffle executable for window=1;
+    # np.int64(9) must mean window 9 (not bool-coerce to a full shuffle)
+    for sizes in (uniform, mixed):
+        for wss in (True, 1, False, 9, np.int64(9)):
+            for ep in (0, 5):
+                host = expand_shard_indices_np(
+                    ids, sizes, seed=4, epoch=ep, within_shard_shuffle=wss
+                )
+                dev = np.asarray(expand_shard_indices_jax(
+                    ids, sizes, seed=4, epoch=ep, within_shard_shuffle=wss
+                ))
+                np.testing.assert_array_equal(dev, host)
+    # reseeds reuse the executable (seed is traced): different seed, same
+    # program cache entry, still bit-identical
+    host = expand_shard_indices_np(ids, uniform, seed=99, epoch=1)
+    dev = np.asarray(expand_shard_indices_jax(ids, uniform, seed=99, epoch=1))
+    np.testing.assert_array_equal(dev, host)
