@@ -37,16 +37,21 @@ def _read_shard(sid: int):
         yield int(OFFSETS[sid]) + local, f"sample-{sid}-{local}"
 
 
-def rank_stream(rank: int, epoch: int, seed: int = 11):
-    """One rank's epoch: shards in partial-shuffle order; within each shard a
-    *bounded* in-shard shuffle (window=64 of the §3 law, so a tar reader
-    needs only a 64-sample decode buffer); then a 256-sample §7.3 shuffle
-    buffer across shard boundaries."""
+def _make_sampler(rank: int, epoch: int, seed: int):
     sampler = PartialShuffleShardSampler(
         NUM_SHARDS, num_replicas=WORLD, rank=rank, window=WINDOW, seed=seed,
         backend="cpu",
     )
     sampler.set_epoch(epoch)
+    return sampler
+
+
+def rank_stream(rank: int, epoch: int, seed: int = 11):
+    """One rank's epoch: shards in partial-shuffle order; within each shard a
+    *bounded* in-shard shuffle (window=64 of the §3 law, so a tar reader
+    needs only a 64-sample decode buffer); then a 256-sample §7.3 shuffle
+    buffer across shard boundaries."""
+    sampler = _make_sampler(rank, epoch, seed)
 
     def samples():
         for sid in sampler:
@@ -61,6 +66,23 @@ def rank_stream(rank: int, epoch: int, seed: int = 11):
                 yield shard[int(local)]
 
     yield from shuffle_buffer(samples(), 256, seed=seed, epoch=epoch)
+
+
+def device_rank_indices(rank: int, epoch: int, seed: int = 11):
+    """The JAX-native variant: the rank's shard stream expanded to global
+    sample indices ON DEVICE (expand_shard_indices_jax — ~46 ms for 1e8
+    indices on the bench rig vs 51 s host, BASELINE.md), left in HBM for a
+    jitted input pipeline (gather + train step).  Bit-identical to the
+    host expansion.  Returns (shard_ids, device_array)."""
+    from partiallyshuffledistributedsampler_tpu.sampler import (
+        expand_shard_indices_jax,
+    )
+
+    shard_ids = list(_make_sampler(rank, epoch, seed))
+    return shard_ids, expand_shard_indices_jax(
+        shard_ids, SHARD_SIZES, seed=seed, epoch=epoch,
+        within_shard_shuffle=64,
+    )
 
 
 if __name__ == "__main__":
@@ -78,3 +100,18 @@ if __name__ == "__main__":
             f"(wrap-pad duplicates: {-(-NUM_SHARDS // WORLD) * WORLD - NUM_SHARDS} shards)"
         )
         assert len(seen) == total  # every sample served despite shard padding
+
+    # the device path serves the same shards' samples without the §7.3
+    # buffer stage (that is a host-stream tool); check it bit-for-bit
+    # against the host expansion of the same shard stream
+    from partiallyshuffledistributedsampler_tpu.sampler import (
+        expand_shard_indices_np,
+    )
+
+    shard_ids, dev = device_rank_indices(0, 0)
+    host = expand_shard_indices_np(
+        shard_ids, SHARD_SIZES, seed=11, epoch=0, within_shard_shuffle=64
+    )
+    np.testing.assert_array_equal(np.asarray(dev), host)
+    print(f"device expansion: rank 0 epoch 0 -> {len(host)} indices in HBM,"
+          " bit-identical to the host expansion")
