@@ -74,14 +74,9 @@ def device_rank_indices(rank: int, epoch: int, seed: int = 11):
     indices on the bench rig vs 51 s host, BASELINE.md), left in HBM for a
     jitted input pipeline (gather + train step).  Bit-identical to the
     host expansion.  Returns (shard_ids, device_array)."""
-    from partiallyshuffledistributedsampler_tpu.sampler import (
-        expand_shard_indices_jax,
-    )
-
-    shard_ids = list(_make_sampler(rank, epoch, seed))
-    return shard_ids, expand_shard_indices_jax(
-        shard_ids, SHARD_SIZES, seed=seed, epoch=epoch,
-        within_shard_shuffle=64,
+    sampler = _make_sampler(rank, epoch, seed)
+    return sampler.epoch_indices().tolist(), sampler.device_epoch_indices(
+        SHARD_SIZES, within_shard_shuffle=64
     )
 
 

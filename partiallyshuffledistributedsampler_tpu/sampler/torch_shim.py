@@ -206,7 +206,13 @@ class PartiallyShuffleDistributedSampler(_TorchSampler):
         with self.regen_timer.measure():
             return self._epoch_indices(epoch)
 
-    def _epoch_indices(self, epoch: Optional[int]) -> np.ndarray:
+    def _epoch_indices(self, epoch: Optional[int], *,
+                       consume_prefetch: bool = True) -> np.ndarray:
+        """``consume_prefetch=False`` reads the epoch without retiring the
+        xla backend's ``set_epoch`` prefetch buffer — for side-channel
+        readers (e.g. shard-mode device expansion) that must not steal the
+        prefetched array from the training loop's upcoming ``__iter__``
+        (which would silently reintroduce the epoch-boundary regen)."""
         e = self.epoch if epoch is None else int(epoch)
         # the elastic remainder regime applies only to the epoch being
         # resumed; an explicit other epoch is an ordinary full epoch
@@ -215,8 +221,9 @@ class PartiallyShuffleDistributedSampler(_TorchSampler):
         if self.backend == "xla":
             if self._pending_epoch == e and self._pending is not None:
                 arr = np.asarray(self._pending)
-                self._pending = None
-                self._pending_epoch = None
+                if consume_prefetch:
+                    self._pending = None
+                    self._pending_epoch = None
                 return arr
             return np.asarray(self._generate_device(e))
         if self.backend == "native":

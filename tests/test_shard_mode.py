@@ -231,3 +231,31 @@ def test_device_expansion_matches_host():
     host = expand_shard_indices_np(ids, uniform, seed=99, epoch=1)
     dev = np.asarray(expand_shard_indices_jax(ids, uniform, seed=99, epoch=1))
     np.testing.assert_array_equal(dev, host)
+
+
+def test_shard_sampler_device_epoch_indices():
+    # the one-call JAX-native shard-mode epoch: sampler shard stream +
+    # device expansion, equal to composing the pieces by hand, with no
+    # consumption-tracking side effects
+    s = PartialShuffleShardSampler(64, num_replicas=4, rank=2, seed=6,
+                                   backend="cpu")
+    s.set_epoch(3)
+    sizes = [25] * 64
+    dev = np.asarray(s.device_epoch_indices(sizes, within_shard_shuffle=5))
+    assert s.state_dict()["offset"] == 0  # the device call consumed nothing
+    ref = expand_shard_indices_np(list(s), sizes, seed=6, epoch=3,
+                                  within_shard_shuffle=5)
+    np.testing.assert_array_equal(dev, ref)
+
+
+def test_device_epoch_indices_preserves_xla_prefetch():
+    # reading the epoch for device expansion must not steal the xla
+    # backend's set_epoch prefetch from the upcoming training __iter__
+    s = PartialShuffleShardSampler(64, num_replicas=4, rank=1, seed=6,
+                                   backend="xla")
+    s.set_epoch(2)
+    assert s._pending is not None
+    s.device_epoch_indices([10] * 64)
+    assert s._pending is not None and s._pending_epoch == 2
+    list(s)  # the training pass still gets the prefetched buffer
+    assert s._pending is None
