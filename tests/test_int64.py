@@ -113,3 +113,49 @@ def test_jax_big_n_without_x64_raises():
         pytest.skip("x64 already on in this process")
     with pytest.raises(ValueError, match="x64"):
         epoch_indices_jax(TEN_B, 8192, 0, 0, 0, 2_000_000)
+
+
+def test_device_shard_expansion_big_total_subprocess():
+    """Shard-mode device expansion in the >= 2^31 total regime: int64
+    output under x64, bit-identical to the host expansion; without x64 it
+    must raise the named error, never emit wrapped indices."""
+    code = textwrap.dedent("""
+        import os
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import numpy as np
+        import partiallyshuffledistributedsampler_tpu as psds
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        psds.enable_big_index_space()
+        from partiallyshuffledistributedsampler_tpu.sampler import (
+            expand_shard_indices_jax, expand_shard_indices_np)
+        # 3 shards of 1e9 + two small ones of different sizes: the total
+        # (3e9+96) exceeds 2^31 so offsets need int64, while expanding
+        # only the two small shards keeps the materialized output tiny —
+        # and their differing sizes drive the mixed-size-class gather path
+        sizes = [1_000_000_000] * 3 + [64, 32]
+        dev = np.asarray(
+            expand_shard_indices_jax([4, 3], sizes, seed=2, epoch=1))
+        host = expand_shard_indices_np([4, 3], sizes, seed=2, epoch=1)
+        assert dev.dtype == np.int64, dev.dtype
+        assert dev.min() >= 3_000_000_000
+        np.testing.assert_array_equal(dev, host)
+        print("BIG_EXPAND_OK")
+    """)
+    res = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=300,
+    )
+    assert "BIG_EXPAND_OK" in res.stdout, res.stderr[-2000:]
+
+
+def test_device_shard_expansion_big_total_without_x64_raises():
+    from partiallyshuffledistributedsampler_tpu.sampler import (
+        expand_shard_indices_jax,
+    )
+    import jax
+
+    if jax.config.read("jax_enable_x64"):  # pragma: no cover
+        pytest.skip("x64 already on in this process")
+    with pytest.raises(ValueError, match="x64"):
+        expand_shard_indices_jax([3], [1_000_000_000] * 3 + [64])

@@ -259,3 +259,35 @@ def test_device_epoch_indices_preserves_xla_prefetch():
     assert s._pending is not None and s._pending_epoch == 2
     list(s)  # the training pass still gets the prefetched buffer
     assert s._pending is None
+
+
+def test_shard_sampler_elastic_reshard():
+    # shard-mode inherits the §6 elastic law: resharding a shard sampler's
+    # checkpoint serves exactly the un-consumed shard stream
+    old_world, new_world, num_shards = 3, 5, 97
+    olds = [
+        PartialShuffleShardSampler(num_shards, num_replicas=old_world,
+                                   rank=r, seed=8, backend="cpu")
+        for r in range(old_world)
+    ]
+    consumed, consumed_ids = 7, []
+    for s in olds:
+        s.set_epoch(4)
+        it = iter(s)
+        consumed_ids += [next(it) for _ in range(consumed)]
+        it.close()
+    state = olds[0].state_dict()
+    remainder_ids = []
+    for r in range(new_world):
+        es = PartialShuffleShardSampler.reshard_from_state_dict(
+            state, num_replicas=new_world, rank=r, backend="cpu"
+        )
+        remainder_ids += list(es)
+    from partiallyshuffledistributedsampler_tpu.ops import cpu as _cpu
+
+    stream = _cpu.full_epoch_stream_np(num_shards, 64, 8, 4,
+                                       world=old_world)
+    from conftest import assert_exactly_once
+
+    assert_exactly_once(consumed_ids, remainder_ids, stream, old_world,
+                        consumed, "strided", new_world)
