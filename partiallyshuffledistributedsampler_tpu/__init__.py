@@ -15,6 +15,9 @@ Public surface
 * ``PartiallyShuffleDistributedSampler`` — drop-in ``torch.utils.data.Sampler``
   (``__iter__``/``__len__``/``set_epoch`` kept intact; ``backend='xla'``
   selects the on-device path).  Importing this attribute requires torch.
+* ``StatefulDataLoader`` — ``DataLoader`` whose ``state_dict()`` is exact
+  mid-epoch even with ``num_workers > 0`` (counts delivered batches in the
+  main process; torchdata convention, no torchdata dependency).
 * ``parallel`` — mesh-sharded regen with ICI seed agreement.
 * ``enable_big_index_space()`` — opt into >=2^31-sample index spaces (x64).
 
@@ -53,4 +56,8 @@ def __getattr__(name):
         from .sampler.torch_shim import PartiallyShuffleDistributedSampler
 
         return PartiallyShuffleDistributedSampler
+    if name == "StatefulDataLoader":
+        from .sampler.stateful_loader import StatefulDataLoader
+
+        return StatefulDataLoader
     raise AttributeError(name)

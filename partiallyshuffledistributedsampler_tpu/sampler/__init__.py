@@ -10,4 +10,5 @@ from .shard_mode import (  # noqa: F401
     shard_seed,
     shuffle_buffer,
 )
+from .stateful_loader import StatefulDataLoader  # noqa: F401
 from .torch_shim import PartiallyShuffleDistributedSampler  # noqa: F401

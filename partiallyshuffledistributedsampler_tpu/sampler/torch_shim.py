@@ -113,10 +113,14 @@ class PartiallyShuffleDistributedSampler(_TorchSampler):
        batches it delivers (``prefetch_factor * num_workers`` batches by
        default), so a bare ``state_dict()`` taken mid-epoch records up to
        that many samples as consumed that the model never trained on —
-       they are silently skipped on resume.  Pass the trained-on count
-       explicitly — ``sampler.state_dict(consumed=steps_done * batch_size)``
-       — whenever ``num_workers > 0``; with ``num_workers=0`` (or the
-       JAX-native ``DeviceEpochIterator``) the default is exact.
+       they are silently skipped on resume.  Wrap the loader in this
+       library's :class:`~partiallyshuffledistributedsampler_tpu.sampler.
+       stateful_loader.StatefulDataLoader` (its ``state_dict()`` counts
+       delivered batches in the main process, so it is exact at any worker
+       count), or pass the trained-on count explicitly —
+       ``sampler.state_dict(consumed=steps_done * batch_size)`` — whenever
+       ``num_workers > 0``; with ``num_workers=0`` (or the JAX-native
+       ``DeviceEpochIterator``) the default is exact.
     """
 
     def __init__(
