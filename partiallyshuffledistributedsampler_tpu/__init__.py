@@ -18,6 +18,10 @@ Public surface
 * ``StatefulDataLoader`` — ``DataLoader`` whose ``state_dict()`` is exact
   mid-epoch even with ``num_workers > 0`` (counts delivered batches in the
   main process; torchdata convention, no torchdata dependency).
+* ``sampler.HostDataLoader`` — host-array → device batch pipeline for
+  JAX-native loops: per-step gather + async ``device_put`` run ``depth``
+  steps ahead on a background thread (the DataLoader-worker overlap,
+  without processes).
 * ``parallel`` — mesh-sharded regen with ICI seed agreement.
 * ``enable_big_index_space()`` — opt into >=2^31-sample index spaces (x64).
 

@@ -29,3 +29,40 @@ def stream_indices_at_jax(*args, **kwargs):
     from .xla import stream_indices_at_jax as _impl
 
     return _impl(*args, **kwargs)
+
+
+def ensure_index_backend(backend: str) -> None:
+    """Eagerly validate that ``backend`` ('cpu'|'native'|'xla') can serve —
+    so consumers fail at construction, not one epoch into a run.  For
+    'native' this loads (or builds) the C++ kernel now."""
+    if backend not in ("cpu", "native", "xla"):
+        raise ValueError(
+            f"backend must be 'cpu', 'native' or 'xla', got {backend!r}"
+        )
+    if backend == "native":
+        from . import native
+
+        if not native.available():
+            native.build()
+
+
+def epoch_indices_host(backend: str, n, window, seed, epoch, rank, world,
+                       **kwargs):
+    """One rank's epoch indices as a HOST numpy array via the chosen
+    backend — the single home of the cpu/native/xla dispatch every
+    host-side consumer shares (torch shim, HostDataLoader).  'xla' runs
+    the device evaluator and reads back once."""
+    if backend == "native":
+        from .native import epoch_indices_native
+
+        return epoch_indices_native(n, window, seed, epoch, rank, world,
+                                    **kwargs)
+    if backend == "xla":
+        import numpy as np
+
+        from .xla import epoch_indices_jax as _jax_impl
+
+        return np.asarray(
+            _jax_impl(n, window, seed, epoch, rank, world, **kwargs)
+        )
+    return epoch_indices_np(n, window, seed, epoch, rank, world, **kwargs)

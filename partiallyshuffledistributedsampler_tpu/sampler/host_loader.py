@@ -1,0 +1,193 @@
+"""Host-resident data → device batches, prefetched behind the train step.
+
+The torch path gets gather+transfer overlap from DataLoader workers; the
+JAX-native path (``DeviceEpochIterator``) keeps *indices* in HBM but says
+nothing about the *data* when it lives in host memory (tokenized shards,
+memmapped arrays — the C4 config's shape).  :class:`HostDataLoader` is that
+missing stage: per step it gathers ``data[idx]`` on the host and ships it
+with an async ``jax.device_put``, running ``depth`` steps ahead on a
+background thread so the gather and the host→device wire hide behind the
+device's compute — the same overlap DataLoader workers buy torch users,
+without processes, pickling, or a collate function.
+
+Determinism: batches are exactly the sampler stream
+(``epoch_indices_np(n, window, seed, epoch, rank, world)``) cut into
+``batch``-sized slices — bit-identical to every other consumer surface of
+the same config, so checkpoints interoperate (resume with ``start_step``).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator, Optional
+
+import numpy as np
+
+from ..ops import core, ensure_index_backend, epoch_indices_host
+
+_SENTINEL = object()
+
+
+class HostDataLoader:
+    """Prefetching loader over a pytree of host arrays.
+
+        loader = HostDataLoader({"x": X, "y": Y}, window=8192, batch=512,
+                                seed=0, rank=r, world=w, depth=2)
+        for epoch in range(E):
+            for batch in loader.epoch(epoch):      # {"x": dev, "y": dev}
+                state = train_step(state, batch)   # gather+wire hidden
+
+    data: a dict (or single array) of host arrays sharing leading dim n.
+    depth: prefetch queue capacity; up to ``depth + 1`` gathered batches
+        are live at once (the producer holds one more while the queue is
+        full).  The default 1 therefore double-buffers.
+    index_backend: 'cpu' (numpy regen, default), 'native' (C++ host
+        kernel), or 'xla' (device regen + one host readback per epoch —
+        only worth it when the rank's shard is large; cf. utils/autotune).
+    drop_last_batch: as in DeviceEpochIterator; False serves the trailing
+        partial batch.
+    device: target for ``jax.device_put`` (default: default device).
+
+    The sampler kwargs (shuffle/drop_last/order_windows/partition/rounds)
+    pass through to the index core unchanged.
+    """
+
+    def __init__(
+        self,
+        data,
+        *,
+        window: int,
+        batch: int,
+        seed: int = 0,
+        rank: int = 0,
+        world: int = 1,
+        depth: int = 1,
+        index_backend: str = "cpu",
+        drop_last_batch: bool = True,
+        device=None,
+        **kwargs,
+    ) -> None:
+        self.data = data if isinstance(data, dict) else {"data": data}
+        if not self.data:
+            raise ValueError("data must contain at least one array")
+        lens = {k: int(np.shape(v)[0]) for k, v in self.data.items()}
+        if len(set(lens.values())) != 1:
+            raise ValueError(f"leading dims differ: {lens}")
+        self.n = next(iter(lens.values()))
+        self._single = not isinstance(data, dict)
+        if not 0 <= rank < world:
+            raise ValueError(f"rank must be in [0, {world}), got {rank}")
+        if depth < 1:
+            raise ValueError(f"depth must be >= 1, got {depth}")
+        try:
+            ensure_index_backend(index_backend)  # incl. native build, eagerly
+        except ValueError as exc:
+            raise ValueError(f"index_backend: {exc}") from None
+        self.window, self.batch = int(window), int(batch)
+        self.seed, self.rank, self.world = int(seed), int(rank), int(world)
+        self.depth = int(depth)
+        self.index_backend = index_backend
+        self.drop_last_batch = bool(drop_last_batch)
+        self.device = device
+        self.kwargs = kwargs
+        self.num_samples, _ = core.shard_sizes(
+            self.n, world, kwargs.get("drop_last", False)
+        )
+        if drop_last_batch:
+            self.steps_per_epoch = self.num_samples // self.batch
+        else:
+            self.steps_per_epoch = -(-self.num_samples // self.batch)
+        if self.steps_per_epoch == 0:
+            raise ValueError(
+                f"batch={batch} exceeds the rank's {self.num_samples} samples"
+            )
+
+    # ------------------------------------------------------------- indices
+    def epoch_indices(self, epoch: int) -> np.ndarray:
+        return epoch_indices_host(
+            self.index_backend, self.n, self.window, self.seed, epoch,
+            self.rank, self.world, **self.kwargs,
+        )
+
+    # -------------------------------------------------------------- epochs
+    def epoch(self, epoch: int, *, start_step: int = 0) -> Iterator:
+        """Device batches for ``epoch``, prefetched ``depth`` steps ahead.
+
+        ``start_step`` resumes mid-epoch (e.g. from a checkpointed step
+        count): batches ``start_step..`` are served, identical to the
+        tail of an uninterrupted epoch.
+        """
+        # validate eagerly AT THE CALL — this method returns a generator,
+        # and a deferred error would fire wherever the caller first pulls it
+        if not 0 <= start_step <= self.steps_per_epoch:
+            raise ValueError(
+                f"start_step {start_step} outside [0, {self.steps_per_epoch}]"
+            )
+        return self._epoch_gen(epoch, start_step)
+
+    def _epoch_gen(self, epoch: int, start_step: int) -> Iterator:
+        import jax
+
+        idx = self.epoch_indices(epoch)
+        q: queue.Queue = queue.Queue(maxsize=self.depth)
+        stop = threading.Event()
+
+        def produce() -> None:
+            try:
+                for s in range(start_step, self.steps_per_epoch):
+                    if stop.is_set():
+                        return
+                    lo = s * self.batch
+                    sl = idx[lo:lo + self.batch]
+                    # host gather then ASYNC device transfer: device_put
+                    # returns immediately; the wire runs while the device
+                    # computes earlier steps
+                    out = {
+                        k: jax.device_put(np.take(v, sl, axis=0), self.device)
+                        for k, v in self.data.items()
+                    }
+                    if self._single:
+                        out = out["data"]
+                    while not stop.is_set():
+                        try:
+                            q.put(out, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
+            except Exception as exc:  # surface gather errors to the consumer
+                while not stop.is_set():
+                    try:
+                        q.put(("__error__", exc), timeout=0.1)
+                        return
+                    except queue.Full:
+                        continue
+            else:
+                while not stop.is_set():
+                    try:
+                        q.put(_SENTINEL, timeout=0.1)
+                        return
+                    except queue.Full:
+                        continue
+
+        t = threading.Thread(target=produce, daemon=True,
+                             name="psds-host-prefetch")
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is _SENTINEL:
+                    break
+                if isinstance(item, tuple) and len(item) == 2 \
+                        and item[0] == "__error__":
+                    raise item[1]
+                yield item
+        finally:
+            # consumer broke out (or errored): unblock and retire the thread
+            stop.set()
+            while True:  # drain so a blocked put can observe stop
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
+            t.join(timeout=5.0)

@@ -37,7 +37,6 @@ except Exception:  # torch is an optional dependency of this framework
     _HAVE_TORCH = False
 
 from ..ops import core
-from ..ops.cpu import epoch_indices_np
 
 SPEC_VERSION = 1
 
@@ -180,13 +179,11 @@ class PartiallyShuffleDistributedSampler(_TorchSampler):
             raise ValueError(
                 f"backend must be 'cpu', 'native', 'xla' or 'auto', got {backend!r}"
             )
-        if backend == "native":
-            from ..ops import native as _native
+        from ..ops import ensure_index_backend
 
-            # a loadable prebuilt .so is enough — only invoke the toolchain
-            # when nothing is loadable, and raise early if that also fails
-            if not _native.available():
-                _native.build()
+        # native: a loadable prebuilt .so is enough — only invoke the
+        # toolchain when nothing is loadable, and raise early if that fails
+        ensure_index_backend(backend)
         self.backend = backend
         self._pending_epoch: Optional[int] = None
         self._pending = None  # in-flight device array for _pending_epoch
@@ -230,20 +227,13 @@ class PartiallyShuffleDistributedSampler(_TorchSampler):
                     self._pending_epoch = None
                 return arr
             return np.asarray(self._generate_device(e))
-        if self.backend == "native":
-            from ..ops.native import epoch_indices_native
+        from ..ops import epoch_indices_host
 
-            return epoch_indices_native(
-                self.n, self.window, self.seed, e, self.rank,
-                self.num_replicas, shuffle=self.shuffle,
-                drop_last=self.drop_last, order_windows=self.order_windows,
-                partition=self.partition, rounds=self.rounds,
-            )
-        return epoch_indices_np(
-            self.n, self.window, self.seed, e, self.rank, self.num_replicas,
-            shuffle=self.shuffle, drop_last=self.drop_last,
-            order_windows=self.order_windows, partition=self.partition,
-            rounds=self.rounds,
+        return epoch_indices_host(
+            self.backend, self.n, self.window, self.seed, e, self.rank,
+            self.num_replicas, shuffle=self.shuffle,
+            drop_last=self.drop_last, order_windows=self.order_windows,
+            partition=self.partition, rounds=self.rounds,
         )
 
     # ---------------------------------------------------------- Sampler API
