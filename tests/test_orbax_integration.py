@@ -1,0 +1,116 @@
+"""Sampler state rides an orbax checkpoint alongside train state.
+
+The JAX-native consumer story (SURVEY.md §5 checkpoint/resume): a training
+job checkpoints params+opt_state with orbax; the sampler's state must ride
+the same checkpoint so data order resumes exactly.  Sampler state is a
+small pure-python dict (seed/epoch/offset + permutation config), which
+orbax round-trips as a pytree — these tests pin that end to end, including
+mid-epoch resume and the config-validation-on-load law surviving the trip,
+and the elastic cascade (world-size change on restore).
+"""
+
+import numpy as np
+import orbax.checkpoint as ocp
+import pytest
+
+from partiallyshuffledistributedsampler_tpu import (
+    PartiallyShuffleDistributedSampler,
+)
+from partiallyshuffledistributedsampler_tpu.ops.cpu import epoch_indices_np
+
+N, WINDOW, WORLD = 1000, 64, 4
+
+
+def make(rank=0, **kw):
+    return PartiallyShuffleDistributedSampler(
+        N, num_replicas=WORLD, rank=rank, window=WINDOW, backend="cpu", **kw)
+
+
+def roundtrip(tmp_path, sampler_state, train_state=None):
+    """The canonical orbax layout: arrays via StandardSave, the sampler's
+    (JSON-serializable) state via JsonSave, in ONE composite checkpoint —
+    the pattern a real training job uses, documented in docs/TUNING.md."""
+    path = tmp_path / "ckpt"
+    save = {"sampler": ocp.args.JsonSave(sampler_state)}
+    restore = {"sampler": ocp.args.JsonRestore()}
+    if train_state is not None:
+        save["state"] = ocp.args.StandardSave(train_state)
+        restore["state"] = ocp.args.StandardRestore()
+    with ocp.Checkpointer(ocp.CompositeCheckpointHandler()) as ckptr:
+        ckptr.save(path, args=ocp.args.Composite(**save))
+    with ocp.Checkpointer(ocp.CompositeCheckpointHandler()) as ckptr:
+        return ckptr.restore(path, args=ocp.args.Composite(**restore))
+
+
+def test_sampler_state_roundtrips_with_train_state(tmp_path):
+    import jax.numpy as jnp
+
+    s = make()
+    s.set_epoch(5)
+    it = iter(s)
+    for _ in range(37):
+        next(it)
+    train_state = {
+        "params": {"w": jnp.arange(8, dtype=jnp.float32)},
+        "step": jnp.int32(37),
+    }
+    restored = roundtrip(tmp_path, s.state_dict(), train_state)
+    s2 = make()
+    s2.load_state_dict(restored["sampler"])
+    resumed = list(s2)
+    ref = epoch_indices_np(N, WINDOW, 0, 5, 0, WORLD).tolist()
+    assert resumed == ref[37:], "orbax-restored sampler diverged mid-epoch"
+    assert np.array_equal(np.asarray(restored["state"]["params"]["w"]),
+                          np.arange(8, dtype=np.float32))
+
+
+def test_config_validation_survives_roundtrip(tmp_path):
+    s = make()
+    s.set_epoch(1)
+    restored = roundtrip(tmp_path, s.state_dict())
+    wrong = PartiallyShuffleDistributedSampler(
+        N, num_replicas=WORLD, rank=0, window=128, backend="cpu")
+    with pytest.raises(ValueError, match="window"):
+        wrong.load_state_dict(restored["sampler"])
+
+
+def test_restored_types_are_plain_enough(tmp_path):
+    """Orbax may restore scalars as numpy types; load_state_dict must accept
+    the restored dict as-is (no manual int() casting by the user)."""
+    s = make()
+    s.set_epoch(2)
+    state = roundtrip(tmp_path, s.state_dict(consumed=10))
+    s2 = make()
+    s2.load_state_dict(state["sampler"])
+    assert list(s2) == epoch_indices_np(N, WINDOW, 0, 2, 0, WORLD).tolist()[10:]
+
+
+def test_elastic_reshard_from_orbax_checkpoint(tmp_path):
+    """Preemption flow: checkpoint at world=4 via orbax, restore into a
+    world=2 job with reshard_from_state_dict — exactly-once coverage."""
+    samplers = [make(rank=r) for r in range(WORLD)]
+    consumed = 40
+    for s in samplers:
+        s.set_epoch(3)
+    state = roundtrip(
+        tmp_path, samplers[0].state_dict(consumed=consumed)
+    )["sampler"]
+    new = [
+        PartiallyShuffleDistributedSampler.reshard_from_state_dict(
+            state, num_replicas=2, rank=r, backend="cpu")
+        for r in range(2)
+    ]
+    # every index not yet consumed (across the OLD world) appears in the
+    # union of the new ranks' remainder epochs
+    old_streams = [epoch_indices_np(N, WINDOW, 0, 3, r, WORLD)
+                   for r in range(WORLD)]
+    eaten = set()
+    for st in old_streams:
+        eaten.update(st[:consumed].tolist())
+    remaining_multiset = []
+    for st in old_streams:
+        remaining_multiset.extend(st[consumed:].tolist())
+    served = []
+    for s2 in new:
+        served.extend(list(s2))
+    assert set(served) >= set(remaining_multiset), "elastic resume lost data"
