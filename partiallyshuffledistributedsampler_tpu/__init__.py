@@ -22,6 +22,10 @@ Public surface
   JAX-native loops: per-step gather + async ``device_put`` run ``depth``
   steps ahead on a background thread (the DataLoader-worker overlap,
   without processes).
+* ``PartialShuffleMixtureSampler`` / ``MixtureSpec`` — weighted
+  multi-source mixing (SPEC.md §8): exact per-block proportions, each
+  source partially shuffled by its own windowed permutation; stateless
+  and random-access like every other stream here.
 * ``parallel`` — mesh-sharded regen with ICI seed agreement.
 * ``enable_big_index_space()`` — opt into >=2^31-sample index spaces (x64).
 
@@ -64,4 +68,12 @@ def __getattr__(name):
         from .sampler.stateful_loader import StatefulDataLoader
 
         return StatefulDataLoader
+    if name == "PartialShuffleMixtureSampler":
+        from .sampler.mixture import PartialShuffleMixtureSampler
+
+        return PartialShuffleMixtureSampler
+    if name == "MixtureSpec":
+        from .ops.mixture import MixtureSpec
+
+        return MixtureSpec
     raise AttributeError(name)

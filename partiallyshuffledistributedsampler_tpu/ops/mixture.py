@@ -1,0 +1,370 @@
+"""Mixture-of-sources stream (SPEC.md §8): weighted multi-dataset sampling.
+
+The multi-corpus pretrain shape (C4 + code + books at fixed proportions):
+each source is partially shuffled by its own §3 windowed permutation, and
+sources interleave at exact per-block proportions via a static smooth
+round-robin pattern.  The whole stream is a pure function of
+``(spec, seed, epoch, position)`` — stateless and O(1) random-access like
+every other stream in this framework, so it partitions across ranks,
+checkpoints, and resumes with the same machinery.
+
+Backend-generic like ops.core: every function takes ``xp`` (numpy or
+jax.numpy) and uses exact uint32/uint64 arithmetic, so CPU and XLA are
+bit-identical by construction.  Cost: O(S * len) — one masked §3 pass per
+source (S is small; weights list a handful of corpora).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional, Sequence, Tuple
+
+import numpy as np
+
+from . import core
+
+#: per-source seed stride (SPEC.md §8.3) — a 64-bit odd constant distinct
+#: from the shard-mode stride (§7.1), so mixture and shard streams over the
+#: same seed are unrelated
+_MIX_SEED_STRIDE = 0xB5297A4D2C7E9FD3
+#: pass-folding constant (§8.3)
+_C_PASS = 0x632BE5AB
+
+DEFAULT_BLOCK = 1024
+
+
+def source_seed(seed: int, s: int) -> int:
+    """§8.3: the per-source seed, evaluated in unbounded integers then
+    folded per §1 by the key schedule."""
+    return int(seed) ^ (_MIX_SEED_STRIDE + int(s))
+
+
+class MixtureSpec:
+    """Validated, immutable mixture description: quotas + static tables.
+
+    sources: sizes ``n_s`` (>= 1 each).
+    weights: integer weights ``v_s`` (>= 1 each; proportions ``v_s/V``).
+    windows: per-source window, or one shared int (default
+        ``core.DEFAULT_WINDOW`` capped at each ``n_s``).
+    block:   pattern block size B (§8.1); every aligned B-block realises
+        the quotas exactly, so any range of length L is within B of exact
+        proportion.
+
+    Raises when a positive-weight source would starve (``k_s == 0``),
+    naming a block size sufficient to serve it.
+    """
+
+    def __init__(
+        self,
+        sources: Sequence[int],
+        weights: Sequence[int],
+        *,
+        windows=None,
+        block: int = DEFAULT_BLOCK,
+    ) -> None:
+        self.sources = tuple(int(n) for n in sources)
+        self.weights = tuple(int(v) for v in weights)
+        if not self.sources:
+            raise ValueError("mixture needs at least one source")
+        if len(self.weights) != len(self.sources):
+            raise ValueError(
+                f"{len(self.sources)} sources but {len(self.weights)} weights"
+            )
+        for s, n in enumerate(self.sources):
+            if n < 1:
+                raise ValueError(f"source {s} has size {n}; must be >= 1")
+        for s, v in enumerate(self.weights):
+            if v < 1:
+                raise ValueError(
+                    f"source {s} has weight {v}; must be >= 1 (drop "
+                    "zero-weight sources before building the spec)"
+                )
+        S = len(self.sources)
+        if windows is None:
+            windows = core.DEFAULT_WINDOW
+        if isinstance(windows, int):
+            windows = [min(int(windows), n) for n in self.sources]
+        self.windows = tuple(int(w) for w in windows)
+        if len(self.windows) != S:
+            raise ValueError(
+                f"{S} sources but {len(self.windows)} windows"
+            )
+        for s, w in enumerate(self.windows):
+            if w < 1:
+                raise ValueError(f"window for source {s} must be >= 1, got {w}")
+        self.block = int(block)
+        if self.block < S:
+            raise ValueError(
+                f"block {self.block} < {S} sources; every source needs a slot"
+            )
+        # --- §8.1 quotas: largest-remainder apportionment ------------------
+        V = sum(self.weights)
+        floors = [v * self.block // V for v in self.weights]
+        rems = [(v * self.block) % V for v in self.weights]
+        left = self.block - sum(floors)
+        # ties toward smaller s: sort by (-remainder, s)
+        for s in sorted(range(S), key=lambda s: (-rems[s], s))[:left]:
+            floors[s] += 1
+        for s, k in enumerate(floors):
+            if k == 0:
+                # ceil(V / v_s) guarantees floor(v_s*B/V) >= 1 — sufficient,
+                # though a smaller B may already serve s via the
+                # largest-remainder top-up
+                need = -(-V // self.weights[s])
+                raise ValueError(
+                    f"source {s} (weight {self.weights[s]}/{V}) gets 0 of "
+                    f"{self.block} block slots; block >= {need} suffices"
+                )
+        self.quotas = tuple(floors)
+        # --- §8.2 pattern: smooth round-robin ------------------------------
+        err = np.zeros(S, dtype=np.int64)
+        k_arr = np.asarray(floors, dtype=np.int64)
+        pattern = np.empty(self.block, dtype=np.int32)
+        prefix = np.zeros((self.block, S), dtype=np.int64)
+        counts = np.zeros(S, dtype=np.int64)
+        for t in range(self.block):
+            prefix[t] = counts
+            s_star = int(np.argmax(err + k_arr))  # argmax ties -> smallest s
+            pattern[t] = s_star
+            err += k_arr
+            err[s_star] -= self.block
+            counts[s_star] += 1
+        pattern.setflags(write=False)
+        prefix.setflags(write=False)
+        self.pattern = pattern  # [B] int32
+        self.prefix = prefix  # [B, S] int64: C_s(t)
+        bases = np.concatenate([[0], np.cumsum(self.sources)[:-1]])
+        self.bases = tuple(int(b) for b in bases)
+        self.total_sources_len = int(sum(self.sources))
+
+    # ------------------------------------------------------------------ info
+    @property
+    def num_sources(self) -> int:
+        return len(self.sources)
+
+    def key(self) -> tuple:
+        """Hashable identity (compiled-program cache key, checkpoint field)."""
+        return (self.sources, self.weights, self.windows, self.block)
+
+    def decompose(self, global_ids):
+        """Split global ids back into (source_id, local_id) arrays."""
+        gids = np.asarray(global_ids)
+        bases = np.asarray(self.bases + (self.total_sources_len,))
+        s = np.searchsorted(bases, gids, side="right") - 1
+        return s.astype(np.int32), gids - bases[s]
+
+
+def mixture_stream_at_generic(
+    xp: Any,
+    positions,
+    spec: MixtureSpec,
+    seed,
+    epoch,
+    *,
+    shuffle: bool = True,
+    order_windows: bool = True,
+    rounds: int = core.DEFAULT_ROUNDS,
+    big_positions: Optional[bool] = None,
+):
+    """§8.3: global ids for arbitrary mixture positions (NOT wrapped —
+    the mixture stream is total).
+
+    Output dtype int32 when the concatenated id space fits, else int64
+    (the position math widens independently — ``big_positions`` — when
+    positions exceed 2^31; jax then requires x64 exactly as in ops.core
+    §5).  ``big_positions`` is inferred from concrete position arrays;
+    traced arrays must pass it explicitly (it is static).
+    """
+    if big_positions is None:
+        big_positions = _needs_big_positions(positions, spec)
+    pos_dtype = xp.uint64 if big_positions else xp.uint32
+    out_dtype = (
+        xp.int32 if spec.total_sources_len <= 0x7FFFFFFF else xp.int64
+    )
+    p = xp.asarray(positions).astype(pos_dtype)
+    B = xp.asarray(spec.block, dtype=pos_dtype)
+    t = (p % B).astype(xp.int32)  # pattern/prefix gather index
+    blk = p // B
+    pattern = xp.asarray(np.asarray(spec.pattern))
+    s_arr = xp.take(pattern, t)
+    out = xp.zeros(p.shape, dtype=out_dtype)
+    for s in range(spec.num_sources):
+        n_s = spec.sources[s]
+        k_s = spec.quotas[s]
+        c_s = xp.asarray(np.ascontiguousarray(spec.prefix[:, s]))
+        j = blk * xp.asarray(k_s, dtype=pos_dtype) \
+            + xp.take(c_s, t).astype(pos_dtype)
+        n_sp = xp.asarray(n_s, dtype=pos_dtype)
+        pas = (j // n_sp).astype(xp.uint32)
+        u = j % n_sp
+        if shuffle:
+            # §8.3 pass-folded epoch (per-lane: pass varies along the batch)
+            ep = core.as_u32_scalar(xp, epoch)
+            ep_u = core.mix32(
+                xp, ep ^ core.mix32(xp, pas ^ core._u32(xp, _C_PASS))
+            )
+            ek = core.derive_epoch_key(xp, source_seed_folded(seed, s), ep_u)
+            idx = core.windowed_perm(
+                xp, u, n_s, spec.windows[s], ek,
+                order_windows=order_windows, rounds=rounds,
+                pos_dtype=xp.uint32 if n_s <= 0x7FFFFFFF else xp.uint64,
+            )
+        else:
+            idx = u
+        gid = xp.asarray(spec.bases[s], dtype=out_dtype) \
+            + idx.astype(out_dtype)
+        out = xp.where(s_arr == xp.asarray(s, dtype=s_arr.dtype), gid, out)
+    return out
+
+
+def source_seed_folded(seed, s: int):
+    """(lo, hi) uint32 pair for source ``s`` — concrete seeds fold through
+    §8.3's unbounded-int XOR; traced seeds are not supported for mixtures
+    (the per-source fold needs the hi half)."""
+    if not isinstance(seed, (int, np.integer)):
+        raise TypeError(
+            "mixture seeds must be concrete python ints (the per-source "
+            "seed derivation operates on the full-width integer)"
+        )
+    lo, hi = core.fold_seed(source_seed(int(seed), s))
+    # np.uint32 halves: jnp.asarray rejects python ints above int32 max
+    return (np.uint32(lo), np.uint32(hi))
+
+
+def _needs_big_positions(positions, spec: MixtureSpec) -> bool:
+    """uint64 position math when positions (or per-source draw counts)
+    can exceed uint32.  Conservative static bound: the caller's max
+    position; per-source j is <= position + B.  Concrete arrays only —
+    a traced array must carry the (static) flag from its caller."""
+    try:
+        arr = np.asarray(positions)
+    except Exception:
+        arr = None
+    if arr is None or arr.dtype == object:
+        raise TypeError(
+            "big_positions must be passed explicitly for traced position "
+            "arrays (it selects the static position dtype)"
+        )
+    pmax = int(arr.max()) if arr.size else 0
+    return pmax + spec.block >= 0x7FFFFFFF
+
+
+def mixture_epoch_sizes(
+    spec: MixtureSpec, epoch_samples: Optional[int], world: int,
+    drop_last: bool,
+) -> Tuple[int, int, int]:
+    """(T, num_samples, total_size) — §8.4's length law over T."""
+    T = spec.total_sources_len if epoch_samples is None else int(epoch_samples)
+    if T < 1:
+        raise ValueError(f"epoch_samples must be >= 1, got {T}")
+    num_samples, total = core.shard_sizes(T, world, drop_last)
+    return T, num_samples, total
+
+
+def mixture_epoch_indices_generic(
+    xp: Any,
+    spec: MixtureSpec,
+    seed,
+    epoch,
+    rank,
+    world: int,
+    *,
+    epoch_samples: Optional[int] = None,
+    shuffle: bool = True,
+    drop_last: bool = False,
+    order_windows: bool = True,
+    partition: str = "strided",
+    rounds: int = core.DEFAULT_ROUNDS,
+):
+    """Rank's mixture-epoch global ids (§8.4).
+
+    Positions are NOT wrapped mod T (the stream is total): padding
+    positions extend the stream instead of duplicating its head, so exact
+    proportions survive padding.
+    """
+    T, num_samples, total = mixture_epoch_sizes(
+        spec, epoch_samples, world, drop_last
+    )
+    pos_dtype = xp.uint32 if total + spec.block <= 0x7FFFFFFF else xp.uint64
+    ar = xp.arange(num_samples, dtype=pos_dtype)
+    rank_p = xp.asarray(rank).astype(pos_dtype)
+    if partition == "strided":
+        p = rank_p + xp.asarray(world, dtype=pos_dtype) * ar
+    elif partition == "blocked":
+        p = rank_p * xp.asarray(num_samples, dtype=pos_dtype) + ar
+    else:
+        raise ValueError(
+            f"partition must be 'strided' or 'blocked', got {partition!r}"
+        )
+    return mixture_stream_at_generic(
+        xp, p, spec, seed, epoch,
+        shuffle=shuffle, order_windows=order_windows, rounds=rounds,
+        big_positions=(pos_dtype == xp.uint64),
+    )
+
+
+# ---------------------------------------------------------------- frontends
+
+def mixture_epoch_indices_np(spec, seed, epoch, rank, world, **kw):
+    """numpy reference frontend."""
+    return mixture_epoch_indices_generic(
+        np, spec, seed, epoch, rank, world, **kw
+    )
+
+
+def mixture_stream_at_np(positions, spec, seed, epoch, **kw):
+    return mixture_stream_at_generic(np, positions, spec, seed, epoch, **kw)
+
+
+def mixture_epoch_indices_jax(spec, seed, epoch, rank, world, **kw):
+    """Jitted device frontend — one compiled program per
+    ``(spec.key(), world, flags)``, reused across epochs and ranks
+    (``epoch``/``rank`` are traced)."""
+    import jax
+
+    fn = _compiled_mixture(
+        spec.key(), int(world),
+        kw.pop("epoch_samples", None),
+        kw.pop("shuffle", True), kw.pop("drop_last", False),
+        kw.pop("order_windows", True), kw.pop("partition", "strided"),
+        kw.pop("rounds", core.DEFAULT_ROUNDS),
+    )
+    if kw:
+        raise TypeError(f"unexpected kwargs: {sorted(kw)}")
+    if not isinstance(seed, (int, np.integer)):
+        raise TypeError(
+            "mixture seeds must be concrete python ints (per-source "
+            "derivation needs the full-width integer)"
+        )
+    return fn(
+        int(seed),
+        core.as_u32_scalar(jax.numpy, epoch),
+        core.as_u32_scalar(jax.numpy, rank),
+    )
+
+
+@functools.lru_cache(maxsize=64)
+def _compiled_mixture(spec_key, world, epoch_samples, shuffle,
+                      drop_last, order_windows, partition, rounds):
+    import jax
+    import jax.numpy as jnp
+
+    sources, weights, windows, block = spec_key
+    spec = MixtureSpec(sources, weights, windows=list(windows), block=block)
+
+    # seed is concrete (per-source fold needs the wide int) -> one
+    # executable per seed value; epoch and rank are traced
+    @functools.lru_cache(maxsize=8)
+    def for_seed(seed: int):
+        @jax.jit
+        def fn(epoch, rank):
+            return mixture_epoch_indices_generic(
+                jnp, spec, seed, epoch, rank, world,
+                epoch_samples=epoch_samples, shuffle=shuffle,
+                drop_last=drop_last, order_windows=order_windows,
+                partition=partition, rounds=rounds,
+            )
+
+        return fn
+
+    return lambda seed, epoch, rank: for_seed(seed)(epoch, rank)

@@ -2,6 +2,7 @@
 
 from .host_loader import HostDataLoader  # noqa: F401
 from .jax_iterator import DeviceEpochIterator, batch_index_window  # noqa: F401
+from .mixture import PartialShuffleMixtureSampler  # noqa: F401
 from .shard_mode import (  # noqa: F401
     PartialShuffleShardSampler,
     expand_shard_indices,

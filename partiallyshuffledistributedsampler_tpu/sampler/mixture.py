@@ -1,0 +1,258 @@
+"""Weighted mixture sampler (SPEC.md §8): the multi-corpus pretrain shape.
+
+``PartialShuffleMixtureSampler`` is the torch-surface sibling of
+``PartiallyShuffleDistributedSampler`` for S weighted sources: it yields
+*global ids* into the concatenated id space (source s's ids live at
+``[base_s, base_s + n_s)``), interleaved at exact per-block proportions,
+each source partially shuffled by its own windowed permutation.  Same
+contract everywhere else: ``set_epoch``/``__len__``/``__iter__``,
+``state_dict``/``load_state_dict`` with config validation, strided/blocked
+rank partition, deterministic in ``(seed, epoch)`` with zero communication.
+
+JAX-native consumers use ``ops.mixture.mixture_epoch_indices_jax`` (the
+same stream as a device array, one compiled program reused across
+epochs/ranks) and ``MixtureSpec.decompose`` to split ids back into
+(source, local) pairs.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+import numpy as np
+
+from ..ops import core
+from ..ops.mixture import (
+    DEFAULT_BLOCK,
+    MixtureSpec,
+    mixture_epoch_indices_np,
+    mixture_epoch_sizes,
+)
+from .torch_shim import SPEC_VERSION, _resolve_identity, _TorchSampler
+
+
+class PartialShuffleMixtureSampler(_TorchSampler):
+    """Distributed weighted-mixture sampler over S sources.
+
+    sources:       per-source sizes ``n_s`` (or Sized datasets).
+    weights:       integer weights (proportions ``v_s / sum(v)``).
+    windows:       per-source window list or one shared int (§8; default
+                   ``DEFAULT_WINDOW`` capped at each source size).
+    block:         mixing block size B — every aligned B-block matches the
+                   quotas exactly (§8.1-8.2).
+    epoch_samples: mixture-epoch length T (default ``sum n_s``).  Sources
+                   whose weighted share exceeds their size repeat with a
+                   fresh permutation per pass; smaller shares see a
+                   weight-proportional prefix of a full permutation.
+    backend:       'cpu' (numpy) or 'xla' (device regen + one readback,
+                   with async epoch prefetch on ``set_epoch``).
+
+    Yields python ints (global ids).  ``decompose(ids)`` maps ids back to
+    (source_id, local_id).
+    """
+
+    def __init__(
+        self,
+        sources,
+        weights,
+        *,
+        num_replicas: Optional[int] = None,
+        rank: Optional[int] = None,
+        seed: int = 0,
+        windows=None,
+        block: int = DEFAULT_BLOCK,
+        epoch_samples: Optional[int] = None,
+        shuffle: bool = True,
+        drop_last: bool = False,
+        order_windows: bool = True,
+        partition: str = "strided",
+        backend: str = "cpu",
+        rounds: int = core.DEFAULT_ROUNDS,
+    ) -> None:
+        sizes = [
+            int(s) if isinstance(s, (int, np.integer)) else len(s)
+            for s in sources
+        ]
+        self.spec = MixtureSpec(sizes, weights, windows=windows, block=block)
+        self.num_replicas, self.rank = _resolve_identity(num_replicas, rank)
+        if not (0 <= self.rank < self.num_replicas):
+            raise ValueError(
+                f"rank must be in [0, {self.num_replicas}), got {self.rank}"
+            )
+        self.seed = int(seed)
+        self.shuffle = bool(shuffle)
+        self.drop_last = bool(drop_last)
+        self.order_windows = bool(order_windows)
+        if partition not in ("strided", "blocked"):
+            raise ValueError(
+                f"partition must be 'strided' or 'blocked', got {partition!r}"
+            )
+        self.partition = partition
+        if backend not in ("cpu", "xla"):
+            raise ValueError(
+                f"backend must be 'cpu' or 'xla', got {backend!r}"
+            )
+        self.backend = backend
+        self.rounds = int(rounds)
+        self.epoch_samples = (
+            None if epoch_samples is None else int(epoch_samples)
+        )
+        self.T, self.num_samples, self.total_size = mixture_epoch_sizes(
+            self.spec, self.epoch_samples, self.num_replicas, self.drop_last
+        )
+        self.epoch = 0
+        self._offset = 0
+        self._consumed = 0
+        self._generation = 0
+        self._pending = None
+        self._pending_epoch: Optional[int] = None
+        from ..utils.metrics import RegenTimer
+
+        self.regen_timer = RegenTimer()
+
+    # ------------------------------------------------------------ generation
+    def _kwargs(self) -> dict:
+        return dict(
+            epoch_samples=self.epoch_samples, shuffle=self.shuffle,
+            drop_last=self.drop_last, order_windows=self.order_windows,
+            partition=self.partition, rounds=self.rounds,
+        )
+
+    def _generate_device(self, epoch: int):
+        from ..ops.mixture import mixture_epoch_indices_jax
+
+        return mixture_epoch_indices_jax(
+            self.spec, self.seed, epoch, self.rank, self.num_replicas,
+            **self._kwargs(),
+        )
+
+    def epoch_indices(self, epoch: Optional[int] = None) -> np.ndarray:
+        """This rank's global-id order for ``epoch`` (default: current)."""
+        e = self.epoch if epoch is None else int(epoch)
+        with self.regen_timer.measure():
+            if self.backend == "xla":
+                if self._pending_epoch == e and self._pending is not None:
+                    arr = np.asarray(self._pending)
+                    self._pending = None
+                    self._pending_epoch = None
+                    return arr
+                return np.asarray(self._generate_device(e))
+            return mixture_epoch_indices_np(
+                self.spec, self.seed, e, self.rank, self.num_replicas,
+                **self._kwargs(),
+            )
+
+    def decompose(self, global_ids):
+        """(source_id, local_id) arrays for served global ids."""
+        return self.spec.decompose(global_ids)
+
+    # ---------------------------------------------------------- Sampler API
+    #: chunked int-boxing, as in the single-source shim: a full
+    #: O(num_samples) .tolist() at multi-corpus scale would reintroduce the
+    #: epoch-boundary stall this framework removes (torch_shim.STREAM_CHUNK)
+    STREAM_CHUNK = 65536
+
+    def __iter__(self) -> Iterator[int]:
+        self._generation += 1
+        gen = self._generation
+        indices = self.epoch_indices()
+        start = self._offset
+        self._offset = 0
+        self._consumed = start
+        chunk = self.STREAM_CHUNK
+        n_total = indices.shape[0]
+        for cs in range(start, n_total, chunk):
+            for i in indices[cs:min(cs + chunk, n_total)].tolist():
+                if self._generation == gen:
+                    self._consumed += 1
+                yield i
+
+    def __len__(self) -> int:
+        return self.num_samples - self._offset
+
+    def set_epoch(self, epoch: int) -> None:
+        e = int(epoch)
+        if e != self.epoch:
+            self._generation += 1
+            self._offset = 0
+            self._consumed = 0
+        self.epoch = e
+        if self.backend == "xla":
+            self._pending = self._generate_device(e)
+            self._pending_epoch = e
+            try:
+                self._pending.copy_to_host_async()
+            except AttributeError:
+                pass
+
+    # ------------------------------------------------------ checkpoint state
+    #: §8 permutation-defining fields validated on load (the mixture
+    #: analogue of the single-source _CONFIG_FIELDS)
+    _CONFIG_FIELDS = (
+        "num_replicas", "shuffle", "drop_last", "order_windows",
+        "partition", "rounds", "epoch_samples",
+    )
+
+    def state_dict(self, consumed: Optional[int] = None) -> dict:
+        state = {
+            "spec_version": SPEC_VERSION,
+            "kind": "mixture",
+            "sources": list(self.spec.sources),
+            "weights": list(self.spec.weights),
+            "windows": list(self.spec.windows),
+            "block": self.spec.block,
+            "seed": self.seed,
+            "epoch": self.epoch,
+            "offset": int(self._consumed if consumed is None else consumed),
+        }
+        for f in self._CONFIG_FIELDS:
+            state[f] = getattr(self, f)
+        return state
+
+    def load_state_dict(self, state: dict) -> None:
+        if state.get("spec_version", SPEC_VERSION) != SPEC_VERSION:
+            raise ValueError(
+                f"checkpoint from spec version {state['spec_version']}, "
+                f"this build implements {SPEC_VERSION}"
+            )
+        if state.get("kind") != "mixture":
+            # a single-source checkpoint's fields (n/window/...) appear in
+            # none of the guards below, so without this check it would load
+            # "successfully" and resume into a completely different stream
+            raise ValueError(
+                f"checkpoint kind {state.get('kind')!r} is not a mixture "
+                "checkpoint; it cannot resume a PartialShuffleMixtureSampler"
+            )
+        spec_fields = {
+            "sources": list(self.spec.sources),
+            "weights": list(self.spec.weights),
+            "windows": list(self.spec.windows),
+            "block": self.spec.block,
+        }
+        for f, mine in spec_fields.items():
+            if f in state and list(np.atleast_1d(state[f])) != list(
+                np.atleast_1d(mine)
+            ):
+                raise ValueError(
+                    f"checkpoint was written with {f}={state[f]!r} but this "
+                    f"sampler has {f}={mine!r}; the offset would resume into "
+                    "a different mixture stream"
+                )
+        for f in self._CONFIG_FIELDS:
+            if f in state and state[f] != getattr(self, f):
+                raise ValueError(
+                    f"checkpoint was written with {f}={state[f]!r} but this "
+                    f"sampler has {f}={getattr(self, f)!r}"
+                )
+        offset = int(state.get("offset", 0))
+        if not (0 <= offset <= self.num_samples):
+            raise ValueError(
+                f"offset {offset} outside [0, {self.num_samples}]"
+            )
+        self.seed = int(state["seed"])
+        self.epoch = int(state["epoch"])
+        self._pending = None
+        self._pending_epoch = None
+        self._offset = offset
+        self._consumed = offset
+        self._generation += 1

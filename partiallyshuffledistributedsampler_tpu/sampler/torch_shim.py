@@ -454,6 +454,7 @@ class PartiallyShuffleDistributedSampler(_TorchSampler):
         (DataLoader workers prefetch indices ahead of delivered batches)."""
         state = {
             "spec_version": SPEC_VERSION,
+            "kind": "single",
             "seed": self.seed,
             "epoch": self.epoch,
             "offset": int(self._consumed if consumed is None else consumed),
@@ -474,6 +475,13 @@ class PartiallyShuffleDistributedSampler(_TorchSampler):
                 f"checkpoint from spec version {state['spec_version']}, "
                 f"this build implements {SPEC_VERSION}; the permutation law "
                 "differs and silent reshuffling would occur"
+            )
+        # pre-round-4 checkpoints carry no kind field: they are all single
+        if state.get("kind", "single") != "single":
+            raise ValueError(
+                f"checkpoint kind {state['kind']!r} cannot resume a "
+                "single-source sampler (mixture checkpoints resume "
+                "PartialShuffleMixtureSampler)"
             )
         for f in self._CONFIG_FIELDS:
             if f in state and state[f] != getattr(self, f):

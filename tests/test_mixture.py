@@ -1,0 +1,351 @@
+"""Mixture-of-sources stream (SPEC.md §8) invariants.
+
+Laws under test: largest-remainder quotas (8.1), smooth-round-robin
+pattern + exact per-block proportions (8.2), the stream law with per-pass
+full permutations and pass/epoch reshuffles (8.3), §4-style partition
+without wrap-padding (8.4), np/jax bit-identity, the torch-surface
+sampler's contract (set_epoch/resume/validation), and a golden freeze.
+"""
+
+import numpy as np
+import pytest
+
+from partiallyshuffledistributedsampler_tpu.ops import mixture as M
+from partiallyshuffledistributedsampler_tpu.ops.cpu import epoch_indices_np
+from partiallyshuffledistributedsampler_tpu.sampler import (
+    PartialShuffleMixtureSampler,
+)
+
+SIZES = [1000, 500, 2500]
+WEIGHTS = [5, 1, 4]
+
+
+def make_spec(**kw):
+    kw.setdefault("windows", 64)
+    kw.setdefault("block", 100)
+    return M.MixtureSpec(SIZES, WEIGHTS, **kw)
+
+
+# ------------------------------------------------------------- 8.1 quotas
+def test_quotas_largest_remainder():
+    spec = make_spec()
+    assert spec.quotas == (50, 10, 40)
+    # remainder distribution: V=7, B=16 -> floors (4,2,9)=15... exercise ties
+    s2 = M.MixtureSpec([10, 10, 10], [1, 1, 1], block=16)
+    assert sum(s2.quotas) == 16
+    assert s2.quotas == (6, 5, 5)  # leftover slot -> smallest s on tie
+
+
+def test_starving_source_rejected_with_min_block():
+    with pytest.raises(ValueError, match="block >= 101"):
+        M.MixtureSpec([100, 100], [100, 1], block=50)
+    M.MixtureSpec([100, 100], [100, 1], block=101)  # the hint works
+
+
+def test_spec_validation():
+    with pytest.raises(ValueError, match="at least one source"):
+        M.MixtureSpec([], [])
+    with pytest.raises(ValueError, match="weights"):
+        M.MixtureSpec([10], [1, 2])
+    with pytest.raises(ValueError, match="size"):
+        M.MixtureSpec([0], [1])
+    with pytest.raises(ValueError, match="weight"):
+        M.MixtureSpec([10, 10], [1, 0])
+    with pytest.raises(ValueError, match="windows"):
+        M.MixtureSpec([10, 10], [1, 1], windows=[5])
+    with pytest.raises(ValueError, match="block"):
+        M.MixtureSpec([10, 10], [1, 1], block=1)
+
+
+# ------------------------------------------------------- 8.2 pattern law
+def test_pattern_realizes_quotas_exactly():
+    spec = make_spec()
+    counts = np.bincount(spec.pattern, minlength=3)
+    assert tuple(counts) == spec.quotas
+    # prefix table consistency
+    for s in range(3):
+        assert spec.prefix[-1, s] + (spec.pattern[-1] == s) == spec.quotas[s]
+
+
+def test_pattern_spreads_evenly():
+    """Smooth round-robin: in every prefix of length L, source s appears
+    within 1 of L * k_s / B (the SRR bound)."""
+    spec = make_spec()
+    B = spec.block
+    for L in range(1, B + 1):
+        c = np.bincount(spec.pattern[:L], minlength=3)
+        for s in range(3):
+            assert abs(c[s] - L * spec.quotas[s] / B) <= 1, (L, s)
+
+
+# ------------------------------------------------------- 8.3 stream law
+def test_proportions_exact_per_block():
+    spec = make_spec()
+    ids = M.mixture_epoch_indices_np(spec, 3, 0, 0, 1)
+    s_ids, _ = spec.decompose(ids)
+    for b in range(len(ids) // spec.block):
+        blk = s_ids[b * spec.block:(b + 1) * spec.block]
+        assert tuple(np.bincount(blk, minlength=3)) == spec.quotas
+
+
+def test_pass_law_full_permutations_and_reshuffle():
+    spec = make_spec()
+    ids = M.mixture_epoch_indices_np(spec, 3, 1, 0, 1)
+    s_ids, loc = spec.decompose(ids)
+    # source 0: 2000 draws over n=1000 -> exactly 2 passes, each a full perm
+    l0 = loc[s_ids == 0]
+    a, b = l0[:1000], l0[1000:]
+    assert sorted(a.tolist()) == list(range(1000))
+    assert sorted(b.tolist()) == list(range(1000))
+    assert not np.array_equal(a, b)  # pass reshuffles
+    # source 1: 400 draws over n=500 -> a distinct prefix of one perm
+    l1 = loc[s_ids == 1]
+    assert len(np.unique(l1)) == len(l1) == 400
+
+
+def test_each_source_stream_is_its_own_windowed_perm():
+    """Source s's pass-0 draw sequence must equal the §3 permutation of
+    [0, n_s) under (source_seed(seed, s), pass-folded epoch) — the §8.3
+    law expressed through the single-source reference implementation."""
+    spec = make_spec()
+    seed, epoch = 11, 4
+    ids = M.mixture_epoch_indices_np(spec, seed, epoch, 0, 1)
+    s_ids, loc = spec.decompose(ids)
+    from partiallyshuffledistributedsampler_tpu.ops import core as C
+
+    for s in [1]:  # source 1 stays in pass 0 for the whole epoch
+        ep_u = int(C.mix32(np, np.uint32(epoch) ^ C.mix32(
+            np, np.uint32(0) ^ np.uint32(0x632BE5AB))))
+        ref = epoch_indices_np(
+            SIZES[s], 64, M.source_seed(seed, s), ep_u, 0, 1)
+        got = loc[s_ids == s]
+        assert np.array_equal(got, ref[:len(got)])
+
+
+def test_determinism_and_epoch_variation():
+    spec = make_spec()
+    a = M.mixture_epoch_indices_np(spec, 7, 3, 0, 1)
+    assert np.array_equal(a, M.mixture_epoch_indices_np(spec, 7, 3, 0, 1))
+    assert not np.array_equal(a, M.mixture_epoch_indices_np(spec, 7, 4, 0, 1))
+    assert not np.array_equal(a, M.mixture_epoch_indices_np(spec, 8, 3, 0, 1))
+
+
+def test_shuffle_false_sequential_interleave():
+    spec = make_spec()
+    ids = M.mixture_epoch_indices_np(spec, 7, 0, 0, 1, shuffle=False)
+    s_ids, loc = spec.decompose(ids)
+    for s in range(3):
+        ls = loc[s_ids == s]
+        n = SIZES[s]
+        assert np.array_equal(ls, np.arange(len(ls)) % n)
+
+
+def test_random_access_matches_epoch():
+    spec = make_spec()
+    full = M.mixture_epoch_indices_np(spec, 7, 2, 0, 1)
+    probes = np.asarray([0, 1, 99, 100, 1234, 3999])
+    got = M.mixture_stream_at_np(probes, spec, 7, 2)
+    assert np.array_equal(got, full[probes])
+
+
+# ------------------------------------------------- 8.4 partition over T
+@pytest.mark.parametrize("partition", ["strided", "blocked"])
+@pytest.mark.parametrize("world", [2, 4])
+def test_partition_reinterleaves_to_full_stream(partition, world):
+    spec = make_spec()
+    shards = [
+        M.mixture_epoch_indices_np(spec, 7, 1, r, world, partition=partition)
+        for r in range(world)
+    ]
+    ns = len(shards[0])
+    inter = np.empty(ns * world, dtype=shards[0].dtype)
+    for r, x in enumerate(shards):
+        if partition == "strided":
+            inter[r::world] = x
+        else:
+            inter[r * ns:(r + 1) * ns] = x
+    # positions beyond T extend the (total) stream rather than wrapping
+    ref = M.mixture_stream_at_np(np.arange(ns * world), spec, 7, 1)
+    assert np.array_equal(inter, ref)
+
+
+def test_padding_preserves_proportions():
+    """T chosen so padding positions exist: they continue the pattern, so
+    aligned blocks keep exact quotas (wrap-padding would skew them)."""
+    spec = make_spec()
+    world = 7
+    shards = [
+        M.mixture_epoch_indices_np(spec, 0, 0, r, world,
+                                   epoch_samples=1001)
+        for r in range(world)
+    ]
+    assert all(len(s) == -(-1001 // world) for s in shards)
+
+
+# ------------------------------------------------------------- jax parity
+def test_np_jax_bit_identical():
+    spec = make_spec()
+    for world, rank, epoch in [(1, 0, 0), (4, 2, 3), (3, 1, 9)]:
+        a = M.mixture_epoch_indices_np(spec, 7, epoch, rank, world)
+        b = np.asarray(
+            M.mixture_epoch_indices_jax(spec, 7, epoch, rank, world))
+        assert np.array_equal(a, b), (world, rank, epoch)
+
+
+def test_jax_executable_reused_across_epochs_and_ranks():
+    spec = make_spec()
+    f1 = M._compiled_mixture(
+        spec.key(), 4, None, True, False, True, "strided", 24)
+    f2 = M._compiled_mixture(
+        spec.key(), 4, None, True, False, True, "strided", 24)
+    assert f1 is f2  # lru-cached per config
+
+
+# --------------------------------------------------------------- goldens
+def test_golden_mixture_frozen():
+    """Spec §8 freeze: changing quotas, pattern, seed folding, pass
+    folding, or the stream law breaks these constants (version bump +
+    regenerated goldens required, per SPEC.md header)."""
+    spec = make_spec()
+    assert spec.pattern[:10].tolist() == [0, 2, 0, 2, 0, 1, 2, 0, 2, 0]
+    ids = M.mixture_epoch_indices_np(spec, 7, 3, 0, 1)
+    assert ids[:8].tolist() == [943, 2784, 902, 2828, 930, 1286, 2832, 952]
+    assert int(ids.sum()) == 5780973
+
+
+# ------------------------------------------------------- sampler surface
+def make_sampler(**kw):
+    kw.setdefault("windows", 64)
+    kw.setdefault("block", 100)
+    kw.setdefault("num_replicas", 2)
+    kw.setdefault("rank", 0)
+    return PartialShuffleMixtureSampler(SIZES, WEIGHTS, **kw)
+
+
+def test_sampler_iter_matches_core():
+    s = make_sampler()
+    s.set_epoch(3)
+    spec = make_spec()
+    ref = M.mixture_epoch_indices_np(spec, 0, 3, 0, 2).tolist()
+    assert list(s) == ref
+    assert len(s) == len(ref)
+
+
+def test_sampler_is_torch_sampler_and_dataloader_works():
+    import torch
+    from torch.utils.data import DataLoader, Sampler, TensorDataset
+
+    s = make_sampler()
+    assert isinstance(s, Sampler)
+    ds = TensorDataset(torch.arange(sum(SIZES)))
+    batches = [b[0] for b in DataLoader(ds, batch_size=64, sampler=s)]
+    assert sum(len(b) for b in batches) == len(s)
+
+
+def test_sampler_resume_and_validation():
+    s = make_sampler()
+    s.set_epoch(2)
+    full = list(s)
+    state = s.state_dict(consumed=100)
+    s2 = make_sampler()
+    s2.load_state_dict(state)
+    assert list(s2) == full[100:]
+    wrong = make_sampler(block=200)
+    with pytest.raises(ValueError, match="block"):
+        wrong.load_state_dict(state)
+    wrong2 = PartialShuffleMixtureSampler(
+        SIZES, [5, 2, 4], num_replicas=2, rank=0, windows=64, block=100)
+    with pytest.raises(ValueError, match="weights"):
+        wrong2.load_state_dict(state)
+
+
+def test_cross_kind_checkpoints_rejected():
+    """A single-source checkpoint must not load into a mixture sampler
+    (none of its config fields overlap, so without the kind check it would
+    'load' silently and resume into a different stream) — and vice versa."""
+    from partiallyshuffledistributedsampler_tpu import (
+        PartiallyShuffleDistributedSampler,
+    )
+
+    single = PartiallyShuffleDistributedSampler(
+        4000, num_replicas=2, rank=0, window=64, backend="cpu")
+    single.set_epoch(1)
+    mix = make_sampler()
+    mix.set_epoch(1)
+    with pytest.raises(ValueError, match="kind"):
+        mix.load_state_dict(single.state_dict(consumed=100))
+    with pytest.raises(ValueError, match="kind"):
+        single.load_state_dict(mix.state_dict(consumed=100))
+    # pre-round-4 single checkpoints carry no kind field: still loadable
+    legacy = single.state_dict(consumed=10)
+    del legacy["kind"]
+    single.load_state_dict(legacy)
+
+
+def test_starvation_hint_is_sufficient_not_minimal():
+    """The error names a SUFFICIENT block (ceil(V/v_s)); a smaller block
+    may already serve the source via the remainder top-up."""
+    with pytest.raises(ValueError, match="block >= 200 suffices"):
+        M.MixtureSpec([10, 10], [199, 1], block=100)
+    spec = M.MixtureSpec([10, 10], [199, 1], block=101)  # top-up serves it
+    assert spec.quotas[1] >= 1
+
+
+def test_sampler_epoch_variation_and_repeat():
+    s = make_sampler()
+    s.set_epoch(0)
+    a = list(s)
+    b = list(s)
+    s.set_epoch(1)
+    c = list(s)
+    assert a == b and a != c
+
+
+def test_sampler_xla_backend_bit_identical():
+    s_cpu = make_sampler()
+    s_dev = make_sampler(backend="xla")
+    for e in (0, 5):
+        s_cpu.set_epoch(e)
+        s_dev.set_epoch(e)
+        assert list(s_dev) == list(s_cpu)
+
+
+def test_sampler_decompose_and_weighted_counts():
+    s = make_sampler(num_replicas=1, rank=0)
+    s.set_epoch(0)
+    ids = np.fromiter(iter(s), dtype=np.int64)
+    src, loc = s.decompose(ids)
+    counts = np.bincount(src, minlength=3)
+    T = sum(SIZES)
+    V = sum(WEIGHTS)
+    for i in range(3):
+        assert abs(counts[i] - T * WEIGHTS[i] / V) <= 100  # within one block
+        ns = SIZES[i]
+        assert loc[src == i].max() < ns
+
+
+def test_sampler_validation_errors():
+    with pytest.raises(ValueError, match="rank"):
+        make_sampler(rank=5)
+    with pytest.raises(ValueError, match="partition"):
+        make_sampler(partition="zig")
+    with pytest.raises(ValueError, match="backend"):
+        make_sampler(backend="native")
+    with pytest.raises(ValueError, match="epoch_samples"):
+        make_sampler(epoch_samples=0)
+
+
+def test_sampler_accepts_sized_datasets():
+    class Sized:
+        def __init__(self, n):
+            self.n = n
+
+        def __len__(self):
+            return self.n
+
+    s = PartialShuffleMixtureSampler(
+        [Sized(1000), Sized(500), Sized(2500)], WEIGHTS,
+        num_replicas=2, rank=0, windows=64, block=100)
+    s2 = make_sampler()
+    s.set_epoch(1), s2.set_epoch(1)
+    assert list(s) == list(s2)
