@@ -248,6 +248,7 @@ def windowed_perm(
     order_windows: bool = True,
     rounds: int = DEFAULT_ROUNDS,
     pos_dtype=None,
+    pair_epoch_key=None,
 ):
     """Map output positions ``p`` (values in [0, n)) to dataset indices.
 
@@ -255,11 +256,18 @@ def windowed_perm(
     position arithmetic (uint32 suffices for n < 2^31; uint64 for the 10B
     index space — requires x64 under jax).  Returned array has ``pos_dtype``.
 
+    ``pair_epoch_key`` (default: ``epoch_key``) feeds the swap-or-not
+    *pairing* schedules (the scalar ``K_r`` hoist, §2); ``epoch_key`` feeds
+    the decision bits and may then vary per element.  The mixture stream
+    (SPEC.md §8.3) uses this split: its pass-folded epoch key is per-lane,
+    but the pairing keys stay scalar so ``K_r``'s ``% m`` stays hoisted.
+
     Static args: n, window, order_windows, rounds — everything shape- or
     branch-relevant is a python int so the jax path traces once per config.
     """
     if pos_dtype is None:
         pos_dtype = xp.uint32 if n <= 0x7FFFFFFF else xp.uint64
+    ek_pair = epoch_key if pair_epoch_key is None else pair_epoch_key
     p = xp.asarray(p).astype(pos_dtype)
     W = int(window)
     if W <= 0:
@@ -281,11 +289,12 @@ def windowed_perm(
         j = xp.where(j > lim, lim, j)  # unsigned min via select (Mosaic-safe)
         r0 = (p % W_p).astype(xp.uint32)
         if order_windows and nw_full > 1:
-            k = swap_or_not(xp, j, nw_full, outer_key(xp, epoch_key), rounds)
+            k = swap_or_not(xp, j, nw_full, outer_key(xp, epoch_key), rounds,
+                            pair_key=outer_key(xp, ek_pair))
         else:
             k = j
         kin = inner_key(xp, epoch_key, k)
-        rho = swap_or_not(xp, r0, W, kin, rounds, pair_key=inner_pair_key(xp, epoch_key))
+        rho = swap_or_not(xp, r0, W, kin, rounds, pair_key=inner_pair_key(xp, ek_pair))
         body_idx = k.astype(pos_dtype) * W_p + rho.astype(pos_dtype)
     else:
         body_idx = p  # no full windows; every lane is tail
@@ -296,7 +305,8 @@ def windowed_perm(
         tlim = _u32(xp, tail_len - 1)
         tpos32 = tpos.astype(xp.uint32)
         tpos32 = xp.where(tpos32 > tlim, tlim, tpos32)
-        rho_t = swap_or_not(xp, tpos32, tail_len, tail_key(xp, epoch_key), rounds)
+        rho_t = swap_or_not(xp, tpos32, tail_len, tail_key(xp, epoch_key),
+                            rounds, pair_key=tail_key(xp, ek_pair))
         tail_idx = body_len_p + rho_t.astype(pos_dtype)
         if nw_full > 0:
             idx = xp.where(p < body_len_p, body_idx, tail_idx)

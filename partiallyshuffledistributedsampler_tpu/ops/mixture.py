@@ -82,7 +82,7 @@ class MixtureSpec:
         S = len(self.sources)
         if windows is None:
             windows = core.DEFAULT_WINDOW
-        if isinstance(windows, int):
+        if isinstance(windows, (int, np.integer)):
             windows = [min(int(windows), n) for n in self.sources]
         self.windows = tuple(int(w) for w in windows)
         if len(self.windows) != S:
@@ -203,11 +203,17 @@ def mixture_stream_at_generic(
             ep_u = core.mix32(
                 xp, ep ^ core.mix32(xp, pas ^ core._u32(xp, _C_PASS))
             )
-            ek = core.derive_epoch_key(xp, source_seed_folded(seed, s), ep_u)
+            seed_pair = source_seed_folded(seed, s)
+            ek = core.derive_epoch_key(xp, seed_pair, ep_u)
+            # pairing keys from the pass-FREE key (§8.3): scalar, so the
+            # swap-or-not K_r '% m' hoist survives the per-lane pass fold
+            # (decision bits still mix the pass, keeping passes distinct)
+            ek0 = core.derive_epoch_key(xp, seed_pair, ep)
             idx = core.windowed_perm(
                 xp, u, n_s, spec.windows[s], ek,
                 order_windows=order_windows, rounds=rounds,
                 pos_dtype=xp.uint32 if n_s <= 0x7FFFFFFF else xp.uint64,
+                pair_epoch_key=ek0,
             )
         else:
             idx = u
@@ -218,17 +224,26 @@ def mixture_stream_at_generic(
 
 
 def source_seed_folded(seed, s: int):
-    """(lo, hi) uint32 pair for source ``s`` — concrete seeds fold through
-    §8.3's unbounded-int XOR; traced seeds are not supported for mixtures
-    (the per-source fold needs the hi half)."""
-    if not isinstance(seed, (int, np.integer)):
-        raise TypeError(
-            "mixture seeds must be concrete python ints (the per-source "
-            "seed derivation operates on the full-width integer)"
-        )
-    lo, hi = core.fold_seed(source_seed(int(seed), s))
-    # np.uint32 halves: jnp.asarray rejects python ints above int32 max
-    return (np.uint32(lo), np.uint32(hi))
+    """(lo, hi) uint32 pair for source ``s``.
+
+    §8.3's unbounded-int XOR decomposes bitwise over the folded halves
+    (``(seed ^ d) & M32 == (seed & M32) ^ (d & M32)`` and likewise for the
+    hi half), so this accepts concrete ints AND already-folded
+    ``(lo, hi)`` pairs of traced uint32 scalars — which is what lets the
+    mesh-sharded program derive per-source seeds from the ICI-agreed
+    triple without a host round-trip."""
+    d = (_MIX_SEED_STRIDE + int(s)) & 0xFFFFFFFFFFFFFFFF
+    d_lo, d_hi = d & 0xFFFFFFFF, (d >> 32) & 0xFFFFFFFF
+    lo, hi = core.fold_seed(seed)
+    if isinstance(lo, (int, np.integer)):
+        lo = np.uint32(int(lo) ^ d_lo)
+    else:  # traced uint32 scalar
+        lo = lo ^ np.uint32(d_lo)
+    if isinstance(hi, (int, np.integer)):
+        hi = np.uint32(int(hi) ^ d_hi)
+    else:
+        hi = hi ^ np.uint32(d_hi)
+    return (lo, hi)
 
 
 def _needs_big_positions(positions, spec: MixtureSpec) -> bool:
@@ -333,8 +348,10 @@ def mixture_epoch_indices_jax(spec, seed, epoch, rank, world, **kw):
         raise TypeError(f"unexpected kwargs: {sorted(kw)}")
     if not isinstance(seed, (int, np.integer)):
         raise TypeError(
-            "mixture seeds must be concrete python ints (per-source "
-            "derivation needs the full-width integer)"
+            "this frontend takes concrete int seeds (it caches one "
+            "executable per seed; seeds rarely vary within a job) — for a "
+            "traced seed use mixture_epoch_indices_generic with a folded "
+            "(lo, hi) pair, as parallel.sharded_mixture_indices does"
         )
     return fn(
         int(seed),

@@ -234,6 +234,89 @@ def sharded_elastic_indices(
     return fn(triple_arr)
 
 
+@functools.lru_cache(maxsize=None)
+def _compiled_sharded_mixture(
+    mesh: Mesh,
+    axis: str,
+    spec_key: tuple,
+    world: int,
+    epoch_samples,
+    shuffle: bool,
+    drop_last: bool,
+    order_windows: bool,
+    partition: str,
+    rounds: int,
+):
+    """Mesh-sharded mixture regen (SPEC.md §8): ICI seed agreement + every
+    device generating ONLY its own mixture shard, one ``shard_map``
+    program.  The per-source seed derivation (§8.3) decomposes bitwise
+    over the agreed (lo, hi) halves, so it runs on the traced triple with
+    no host involvement (ops.mixture.source_seed_folded)."""
+    from ..ops.mixture import (
+        MixtureSpec, mixture_epoch_indices_generic,
+    )
+
+    sources, weights, windows, block = spec_key
+    spec = MixtureSpec(sources, weights, windows=list(windows), block=block)
+
+    def per_device(local_triple):
+        rank = jax.lax.axis_index(axis)
+        mine = local_triple[0]
+        masked = jnp.where(rank == 0, mine, jnp.zeros_like(mine))
+        agreed = jax.lax.psum(masked, axis)
+        out = mixture_epoch_indices_generic(
+            jnp, spec, (agreed[0], agreed[1]), agreed[2],
+            rank.astype(jnp.uint32), world,
+            epoch_samples=epoch_samples, shuffle=shuffle,
+            drop_last=drop_last, order_windows=order_windows,
+            partition=partition, rounds=rounds,
+        )
+        return out[None, :]
+
+    from jax import shard_map
+
+    fn = shard_map(
+        per_device,
+        mesh=mesh,
+        in_specs=(P(axis, None),),
+        out_specs=P(axis, None),
+    )
+    in_sharding = NamedSharding(mesh, P(axis, None))
+    return jax.jit(fn, in_shardings=(in_sharding,))
+
+
+def sharded_mixture_indices(
+    mesh: Mesh,
+    spec,
+    seed,
+    epoch,
+    *,
+    axis: str = "data",
+    epoch_samples=None,
+    shuffle: bool = True,
+    drop_last: bool = False,
+    order_windows: bool = True,
+    partition: str = "strided",
+    rounds: int = core.DEFAULT_ROUNDS,
+    local_seeds=None,
+) -> jax.Array:
+    """All ranks' mixture-epoch global ids as one mesh-sharded array
+    ``[world, num_samples]`` (SPEC.md §8).  Row ``r`` lives on device
+    ``r`` and equals ``mixture_epoch_indices_np(spec, seed, epoch, r,
+    world)`` bit-exactly; the epoch seed is agreed over ICI inside the
+    same program, exactly like :func:`sharded_epoch_indices`."""
+    world = mesh.shape[axis]
+    fn = _compiled_sharded_mixture(
+        mesh, axis, spec.key(), int(world),
+        None if epoch_samples is None else int(epoch_samples),
+        bool(shuffle), bool(drop_last), bool(order_windows),
+        str(partition), int(rounds),
+    )
+    triple_arr = make_seed_triple(mesh, seed, epoch, axis=axis,
+                                  local_seeds=local_seeds)
+    return fn(triple_arr)
+
+
 def make_seed_triple(mesh: Mesh, seed, epoch, *, axis: str = "data",
                      local_seeds=None) -> jax.Array:
     """The mesh-sharded uint32[world, 3] (seed_lo, seed_hi, epoch) input

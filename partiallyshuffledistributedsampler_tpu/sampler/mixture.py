@@ -17,7 +17,7 @@ epochs/ranks) and ``MixtureSpec.decompose`` to split ids back into
 
 from __future__ import annotations
 
-from typing import Iterator, Optional
+from typing import Optional
 
 import numpy as np
 
@@ -28,10 +28,11 @@ from ..ops.mixture import (
     mixture_epoch_indices_np,
     mixture_epoch_sizes,
 )
+from ._chunked_iter import ChunkedIterMixin
 from .torch_shim import SPEC_VERSION, _resolve_identity, _TorchSampler
 
 
-class PartialShuffleMixtureSampler(_TorchSampler):
+class PartialShuffleMixtureSampler(ChunkedIterMixin, _TorchSampler):
     """Distributed weighted-mixture sampler over S sources.
 
     sources:       per-source sizes ``n_s`` (or Sized datasets).
@@ -147,25 +148,7 @@ class PartialShuffleMixtureSampler(_TorchSampler):
         return self.spec.decompose(global_ids)
 
     # ---------------------------------------------------------- Sampler API
-    #: chunked int-boxing, as in the single-source shim: a full
-    #: O(num_samples) .tolist() at multi-corpus scale would reintroduce the
-    #: epoch-boundary stall this framework removes (torch_shim.STREAM_CHUNK)
-    STREAM_CHUNK = 65536
-
-    def __iter__(self) -> Iterator[int]:
-        self._generation += 1
-        gen = self._generation
-        indices = self.epoch_indices()
-        start = self._offset
-        self._offset = 0
-        self._consumed = start
-        chunk = self.STREAM_CHUNK
-        n_total = indices.shape[0]
-        for cs in range(start, n_total, chunk):
-            for i in indices[cs:min(cs + chunk, n_total)].tolist():
-                if self._generation == gen:
-                    self._consumed += 1
-                yield i
+    # __iter__ from ChunkedIterMixin (shared with the single-source shim)
 
     def __len__(self) -> int:
         return self.num_samples - self._offset

@@ -24,7 +24,7 @@ Beyond the reference surface:
 from __future__ import annotations
 
 import math
-from typing import Iterator, Optional, Union
+from typing import Optional, Union
 
 import numpy as np
 
@@ -37,6 +37,7 @@ except Exception:  # torch is an optional dependency of this framework
     _HAVE_TORCH = False
 
 from ..ops import core
+from ._chunked_iter import ChunkedIterMixin
 
 SPEC_VERSION = 1
 
@@ -77,7 +78,7 @@ def _elastic_layers_from_state(el):
     return [(int(el["old_world"]), int(el["consumed"]))]
 
 
-class PartiallyShuffleDistributedSampler(_TorchSampler):
+class PartiallyShuffleDistributedSampler(ChunkedIterMixin, _TorchSampler):
     """Partial-shuffle distributed sampler with an on-device XLA backend.
 
     Parameters follow ``DistributedSampler`` (dataset, num_replicas, rank,
@@ -237,34 +238,9 @@ class PartiallyShuffleDistributedSampler(_TorchSampler):
         )
 
     # ---------------------------------------------------------- Sampler API
-    #: indices are converted to python ints in chunks of this size, so the
-    #: first batch is dispatchable ~immediately instead of after a full
-    #: O(num_samples) ``.tolist()`` (360 ms at 1e7 per BASELINE.md) — the
-    #: epoch-boundary stall the on-device regen removed must not sneak back
-    #: in through host-side conversion (SURVEY.md §7 hard part 3)
-    STREAM_CHUNK = 65536
-
-    def __iter__(self) -> Iterator[int]:
-        # claim the consumed counter for THIS iteration: any later __iter__,
-        # set_epoch or load_state_dict bumps the generation, so a generator
-        # still draining from before (the prefetch pattern, a second live
-        # iterator, a same-epoch state load with a different offset) can
-        # never write a stale count into the next checkpoint
-        self._generation += 1
-        gen = self._generation
-        indices = self.epoch_indices()
-        start = self._offset
-        self._offset = 0  # a fresh epoch starts at 0 unless state is loaded
-        self._consumed = start
-        chunk = self.STREAM_CHUNK
-        n_total = indices.shape[0]
-        for cs in range(start, n_total, chunk):
-            # one small tolist per chunk: device->host transfer was already
-            # async (set_epoch), so the only per-chunk cost is int-boxing
-            for i in indices[cs:min(cs + chunk, n_total)].tolist():
-                if self._generation == gen:
-                    self._consumed += 1
-                yield i
+    # __iter__ comes from ChunkedIterMixin: generation-token ownership +
+    # chunked int-boxing, shared verbatim with the mixture sampler so the
+    # stale-checkpoint guard can never diverge between them.
 
     @property
     def _effective_num_samples(self) -> int:

@@ -105,8 +105,9 @@ def test_pass_law_full_permutations_and_reshuffle():
 
 def test_each_source_stream_is_its_own_windowed_perm():
     """Source s's pass-0 draw sequence must equal the §3 permutation of
-    [0, n_s) under (source_seed(seed, s), pass-folded epoch) — the §8.3
-    law expressed through the single-source reference implementation."""
+    [0, n_s) with §8.3's split key schedule: decision keys from the
+    pass-folded epoch, pairing keys from the pass-free epoch — the law
+    expressed through the core primitives directly."""
     spec = make_spec()
     seed, epoch = 11, 4
     ids = M.mixture_epoch_indices_np(spec, seed, epoch, 0, 1)
@@ -114,12 +115,17 @@ def test_each_source_stream_is_its_own_windowed_perm():
     from partiallyshuffledistributedsampler_tpu.ops import core as C
 
     for s in [1]:  # source 1 stays in pass 0 for the whole epoch
-        ep_u = int(C.mix32(np, np.uint32(epoch) ^ C.mix32(
-            np, np.uint32(0) ^ np.uint32(0x632BE5AB))))
-        ref = epoch_indices_np(
-            SIZES[s], 64, M.source_seed(seed, s), ep_u, 0, 1)
+        ep_u = C.mix32(np, np.uint32(epoch) ^ C.mix32(
+            np, np.uint32(0) ^ np.uint32(0x632BE5AB)))
+        pair = M.source_seed_folded(seed, s)
+        ek = C.derive_epoch_key(np, pair, ep_u)
+        ek0 = C.derive_epoch_key(np, pair, np.uint32(epoch))
         got = loc[s_ids == s]
-        assert np.array_equal(got, ref[:len(got)])
+        ref = C.windowed_perm(
+            np, np.arange(len(got), dtype=np.uint32), SIZES[s], 64, ek,
+            pair_epoch_key=ek0,
+        )
+        assert np.array_equal(got, ref.astype(got.dtype))
 
 
 def test_determinism_and_epoch_variation():
@@ -201,6 +207,54 @@ def test_jax_executable_reused_across_epochs_and_ranks():
     assert f1 is f2  # lru-cached per config
 
 
+# ------------------------------------------------------- mesh/ICI path
+def test_sharded_mixture_matches_numpy_per_rank():
+    from partiallyshuffledistributedsampler_tpu.parallel import (
+        data_mesh, sharded_mixture_indices,
+    )
+
+    spec = make_spec()
+    mesh = data_mesh()
+    world = mesh.shape["data"]
+    assert world == 8  # conftest forces the 8-device CPU platform
+    out = np.asarray(sharded_mixture_indices(mesh, spec, 7, 3))
+    assert out.shape[0] == world
+    for r in range(world):
+        ref = M.mixture_epoch_indices_np(spec, 7, 3, r, world)
+        assert np.array_equal(out[r], ref), f"rank {r}"
+
+
+def test_sharded_mixture_seed_agreement_rank0_wins():
+    from partiallyshuffledistributedsampler_tpu.parallel import (
+        data_mesh, sharded_mixture_indices,
+    )
+
+    spec = make_spec()
+    mesh = data_mesh()
+    world = mesh.shape["data"]
+    ref = np.asarray(sharded_mixture_indices(mesh, spec, 7, 3))
+    local = np.asarray(
+        [[7, 0, 3]] + [[999 + r, r, 88] for r in range(1, world)],
+        dtype=np.uint32,
+    )
+    out = np.asarray(
+        sharded_mixture_indices(mesh, spec, 7, 3, local_seeds=local))
+    assert np.array_equal(out, ref)
+
+
+def test_wide_seed_half_decomposition():
+    """§8.3's unbounded-int XOR == the folded-half XOR the mesh program
+    uses on the traced triple (the property that makes the ICI path
+    possible without a host round-trip)."""
+    spec = make_spec()
+    wide = (123 << 40) | 456
+    a = M.mixture_epoch_indices_np(spec, wide, 0, 0, 2)
+    lo, hi = wide & 0xFFFFFFFF, (wide >> 32) & 0xFFFFFFFF
+    b = M.mixture_epoch_indices_generic(
+        np, spec, (np.uint32(lo), np.uint32(hi)), 0, 0, 2)
+    assert np.array_equal(a, b)
+
+
 # --------------------------------------------------------------- goldens
 def test_golden_mixture_frozen():
     """Spec §8 freeze: changing quotas, pattern, seed folding, pass
@@ -209,8 +263,8 @@ def test_golden_mixture_frozen():
     spec = make_spec()
     assert spec.pattern[:10].tolist() == [0, 2, 0, 2, 0, 1, 2, 0, 2, 0]
     ids = M.mixture_epoch_indices_np(spec, 7, 3, 0, 1)
-    assert ids[:8].tolist() == [943, 2784, 902, 2828, 930, 1286, 2832, 952]
-    assert int(ids.sum()) == 5780973
+    assert ids[:8].tolist() == [394, 2255, 425, 2252, 411, 1363, 2260, 402]
+    assert int(ids.sum()) == 5793243
 
 
 # ------------------------------------------------------- sampler surface
