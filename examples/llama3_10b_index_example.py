@@ -130,6 +130,32 @@ def main() -> None:
           f"mesh, seed agreed by the in-program collective each time "
           f"(divergent inputs, rank 0 won), {per_reseed_ms:.1f} ms/reseed "
           f"wall incl. dispatch")
+
+    # --- tier 4: the pretrain DATA MIXTURE (SPEC.md §8) over the mesh ----
+    # Llama-style corpus mixing: web/code/books at fixed proportions, each
+    # source partially shuffled, interleaved at exact per-block quotas,
+    # served shard-per-device with the same in-program seed agreement.
+    from partiallyshuffledistributedsampler_tpu.ops.mixture import (
+        MixtureSpec, mixture_epoch_indices_np,
+    )
+    from partiallyshuffledistributedsampler_tpu.parallel import (
+        sharded_mixture_indices,
+    )
+
+    spec = MixtureSpec(
+        sources=[700_000, 200_000, 100_000],  # web / code / books
+        weights=[70, 20, 10],
+        windows=WINDOW,
+    )
+    mids = np.asarray(sharded_mixture_indices(mesh, spec, 7, 0))
+    for r in range(world):
+        assert (mids[r] == mixture_epoch_indices_np(
+            spec, 7, 0, r, world)).all()
+    src, _ = spec.decompose(mids.reshape(-1))
+    counts = np.bincount(src, minlength=3) / len(src)
+    print(f"tier 4: 70/20/10 corpus mixture over the mesh — realized "
+          f"proportions {counts.round(4).tolist()} (exact per 1024-block), "
+          f"per-device shards bit-identical to the numpy law")
     print("ok: config-5 shape end to end")
 
 

@@ -287,3 +287,97 @@ def test_elastic_exactly_once_property(cfg):
     )
     assert_exactly_once(consumed_vals, remainder_vals, stream, ow,
                         consumed, cfg["partition"], nw_)
+
+
+# ---------------------------------------------------------------------------
+# Mixture stream (SPEC.md §8)
+# ---------------------------------------------------------------------------
+
+MIX_CONFIGS = st.fixed_dictionaries(dict(
+    sizes=st.lists(st.integers(1, 800), min_size=1, max_size=5),
+    weights_seed=st.integers(0, 2**31 - 1),
+    block=st.integers(4, 300),
+    seed=st.integers(0, 2**63 - 1),
+    epoch=st.integers(0, 1000),
+    world=st.integers(1, 7),
+    partition=st.sampled_from(["strided", "blocked"]),
+))
+
+
+def _mix_spec(cfg):
+    from partiallyshuffledistributedsampler_tpu.ops import mixture as M
+
+    rng = np.random.default_rng(cfg["weights_seed"])
+    weights = rng.integers(1, 20, size=len(cfg["sizes"])).tolist()
+    try:
+        return M.MixtureSpec(
+            cfg["sizes"], weights,
+            windows=int(rng.integers(1, 200)), block=cfg["block"],
+        )
+    except ValueError:
+        return None  # starved source for this (weights, block) draw
+
+
+@settings(max_examples=60, **SETTINGS)
+@given(cfg=MIX_CONFIGS)
+def test_mixture_quotas_pattern_and_partition(cfg):
+    """§8 invariants under random configs: quotas sum to the block and are
+    realized exactly by every aligned block; the rank partition
+    reinterleaves to the total stream; per-(epoch, pass) draws from a
+    source never repeat."""
+    from partiallyshuffledistributedsampler_tpu.ops import mixture as M
+
+    spec = _mix_spec(cfg)
+    if spec is None:
+        return
+    assert sum(spec.quotas) == spec.block
+    counts = np.bincount(spec.pattern, minlength=spec.num_sources)
+    assert tuple(counts) == spec.quotas
+
+    world = cfg["world"]
+    shards = [
+        M.mixture_epoch_indices_np(
+            spec, cfg["seed"], cfg["epoch"], r, world,
+            partition=cfg["partition"],
+        )
+        for r in range(world)
+    ]
+    ns = len(shards[0])
+    assert all(len(s) == ns for s in shards)
+    inter = np.empty(ns * world, dtype=shards[0].dtype)
+    for r, x in enumerate(shards):
+        if cfg["partition"] == "strided":
+            inter[r::world] = x
+        else:
+            inter[r * ns:(r + 1) * ns] = x
+    ref = M.mixture_stream_at_np(
+        np.arange(ns * world), spec, cfg["seed"], cfg["epoch"])
+    assert np.array_equal(inter, ref)
+
+    # per-(epoch, pass) no-repeat, per source, over the full stream
+    src, loc = spec.decompose(ref)
+    for s in range(spec.num_sources):
+        ls = loc[src == s]
+        n_s = spec.sources[s]
+        for p0 in range(0, len(ls), n_s):
+            chunk = ls[p0:p0 + n_s]
+            assert len(np.unique(chunk)) == len(chunk), (s, p0)
+
+
+@settings(max_examples=40, **SETTINGS)
+@given(cfg=MIX_CONFIGS)
+def test_mixture_determinism_and_block_proportions(cfg):
+    from partiallyshuffledistributedsampler_tpu.ops import mixture as M
+
+    spec = _mix_spec(cfg)
+    if spec is None:
+        return
+    a = M.mixture_epoch_indices_np(spec, cfg["seed"], cfg["epoch"], 0, 1)
+    b = M.mixture_epoch_indices_np(spec, cfg["seed"], cfg["epoch"], 0, 1)
+    assert np.array_equal(a, b)
+    src, _ = spec.decompose(a)
+    B = spec.block
+    for blk in range(len(a) // B):
+        c = np.bincount(src[blk * B:(blk + 1) * B],
+                        minlength=spec.num_sources)
+        assert tuple(c) == spec.quotas
