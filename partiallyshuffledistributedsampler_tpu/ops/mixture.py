@@ -154,6 +154,83 @@ class MixtureSpec:
         return s.astype(np.int32), gids - bases[s]
 
 
+#: amortized-evaluator guard: combined per-source table elements
+#: (P * (nw + tail)) beyond this fall back to the per-lane general path
+_TABLE_CAP = 8_000_000
+
+
+def _amortized_source_perm(xp, u, pas, n_s, W, seed_pair, ep, P,
+                           order_windows, rounds, pos_dtype):
+    """§3 permutation over [0, n_s) with the §8.3 split key schedule,
+    evaluated the amortized way: the outer (window-order) and tail
+    bijections are computed ONCE per (pass, domain-element) as small
+    tables — total table work ~ P*(nw+tail), independent of lane count —
+    and looked up per lane; only the inner per-window bijection (whose key
+    varies per lane by construction) runs per lane.  Bit-identical to
+    ``core.windowed_perm`` with the same keys: same bijections, same
+    inputs, different evaluation order.
+    """
+    nw = n_s // W
+    body_len = nw * W
+    tail_len = n_s - body_len
+    # per-pass epoch keys (decision side) + the pass-free pairing key
+    qs = xp.arange(P, dtype=xp.uint32)
+    ep_s = core.as_u32_scalar(xp, ep)
+    ep_u = core.mix32(xp, ep_s ^ core.mix32(xp, qs ^ core._u32(xp, _C_PASS)))
+    ek_q = core.derive_epoch_key(xp, seed_pair, ep_u)  # [P]
+    ek0 = core.derive_epoch_key(xp, seed_pair, ep_s)  # scalar
+    # clip pass for gather safety (masked other-source lanes only)
+    pmax = core._u32(xp, P - 1)
+    pas_c = xp.where(pas > pmax, pmax, pas)
+    ek_lane = ek_q[pas_c]
+
+    if nw > 0:
+        win = (u // xp.asarray(W, dtype=pos_dtype)).astype(xp.uint32)
+        lim = core._u32(xp, nw - 1)
+        win = xp.where(win > lim, lim, win)  # tail lanes clipped, masked out
+        r0 = (u % xp.asarray(W, dtype=pos_dtype)).astype(xp.uint32)
+        if order_windows and nw > 1:
+            j_dom = xp.arange(nw, dtype=xp.uint32)[None, :]
+            outer_tab = core.swap_or_not(
+                xp, j_dom, nw, core.outer_key(xp, ek_q)[:, None], rounds,
+                pair_key=core.outer_key(xp, ek0),
+            )  # [P, nw]
+            k = outer_tab[pas_c, win]
+        else:
+            k = win
+        kin = core.inner_key(xp, ek_lane, k)
+        rho = core.swap_or_not(
+            xp, r0, W, kin, rounds,
+            pair_key=core.inner_pair_key(xp, ek0),
+        )
+        body_idx = k.astype(pos_dtype) * xp.asarray(W, dtype=pos_dtype) \
+            + rho.astype(pos_dtype)
+    else:
+        body_idx = u
+    if tail_len > 0:
+        body_len_p = xp.asarray(body_len, dtype=pos_dtype)
+        if tail_len == 1:
+            # domain of size 1: the bijection is the identity (swap_or_not
+            # early-returns its input there, so no [P, 1] table exists)
+            tail_vals = xp.zeros(u.shape, dtype=pos_dtype)
+        else:
+            tpos = xp.where(u >= body_len_p, u - body_len_p,
+                            xp.asarray(0, dtype=pos_dtype)).astype(xp.uint32)
+            tlim = core._u32(xp, tail_len - 1)
+            tpos = xp.where(tpos > tlim, tlim, tpos)
+            t_dom = xp.arange(tail_len, dtype=xp.uint32)[None, :]
+            tail_tab = core.swap_or_not(
+                xp, t_dom, tail_len, core.tail_key(xp, ek_q)[:, None],
+                rounds, pair_key=core.tail_key(xp, ek0),
+            )  # [P, tail]
+            tail_vals = tail_tab[pas_c, tpos].astype(pos_dtype)
+        tail_idx = body_len_p + tail_vals
+        if nw > 0:
+            return xp.where(u < body_len_p, body_idx, tail_idx)
+        return tail_idx
+    return body_idx
+
+
 def mixture_stream_at_generic(
     xp: Any,
     positions,
@@ -165,6 +242,8 @@ def mixture_stream_at_generic(
     order_windows: bool = True,
     rounds: int = core.DEFAULT_ROUNDS,
     big_positions: Optional[bool] = None,
+    amortize: bool = True,
+    max_position: Optional[int] = None,
 ):
     """§8.3: global ids for arbitrary mixture positions (NOT wrapped —
     the mixture stream is total).
@@ -174,9 +253,38 @@ def mixture_stream_at_generic(
     positions exceed 2^31; jax then requires x64 exactly as in ops.core
     §5).  ``big_positions`` is inferred from concrete position arrays;
     traced arrays must pass it explicitly (it is static).
+
+    ``amortize`` selects the table-based evaluator (outer/tail bijections
+    once per (source, pass) instead of per lane — a ~3x cut in bijection
+    rounds per lane on paper; measured parity-within-noise on this rig's
+    emulator, where per-op cost dominates — BASELINE.md round-4 notes).
+    It needs a static position bound (``max_position``, inferred from
+    concrete arrays) to size the pass tables and silently falls back to
+    the per-lane path without one, when a (tiny-window, huge-source)
+    table would exceed the cap, or when the query is too small for table
+    construction to pay for itself.  The value is bit-identical either
+    way — this is purely an evaluation strategy, tested as such.
     """
+    concrete = None
+    if big_positions is None or (amortize and max_position is None):
+        try:
+            concrete = np.asarray(positions)
+            if concrete.dtype == object:
+                concrete = None
+        except Exception:
+            concrete = None  # traced positions
     if big_positions is None:
-        big_positions = _needs_big_positions(positions, spec)
+        if concrete is None:
+            raise TypeError(
+                "big_positions must be passed explicitly for traced "
+                "position arrays (it selects the static position dtype)"
+            )
+        pmax_c = int(concrete.max()) if concrete.size else 0
+        big_positions = pmax_c + spec.block >= 0x7FFFFFFF
+        if amortize and max_position is None:
+            max_position = pmax_c
+    elif amortize and max_position is None and concrete is not None:
+        max_position = int(concrete.max()) if concrete.size else 0
     pos_dtype = xp.uint64 if big_positions else xp.uint32
     out_dtype = (
         xp.int32 if spec.total_sources_len <= 0x7FFFFFFF else xp.int64
@@ -191,36 +299,64 @@ def mixture_stream_at_generic(
     for s in range(spec.num_sources):
         n_s = spec.sources[s]
         k_s = spec.quotas[s]
+        W_s = spec.windows[s]
         c_s = xp.asarray(np.ascontiguousarray(spec.prefix[:, s]))
         j = blk * xp.asarray(k_s, dtype=pos_dtype) \
             + xp.take(c_s, t).astype(pos_dtype)
         n_sp = xp.asarray(n_s, dtype=pos_dtype)
         pas = (j // n_sp).astype(xp.uint32)
         u = j % n_sp
+        src_pos_dtype = xp.uint32 if n_s <= 0x7FFFFFFF else xp.uint64
         if shuffle:
-            # §8.3 pass-folded epoch (per-lane: pass varies along the batch)
-            ep = core.as_u32_scalar(xp, epoch)
-            ep_u = core.mix32(
-                xp, ep ^ core.mix32(xp, pas ^ core._u32(xp, _C_PASS))
-            )
             seed_pair = source_seed_folded(seed, s)
-            ek = core.derive_epoch_key(xp, seed_pair, ep_u)
-            # pairing keys from the pass-FREE key (§8.3): scalar, so the
-            # swap-or-not K_r '% m' hoist survives the per-lane pass fold
-            # (decision bits still mix the pass, keeping passes distinct)
-            ek0 = core.derive_epoch_key(xp, seed_pair, ep)
-            idx = core.windowed_perm(
-                xp, u, n_s, spec.windows[s], ek,
-                order_windows=order_windows, rounds=rounds,
-                pos_dtype=xp.uint32 if n_s <= 0x7FFFFFFF else xp.uint64,
-                pair_epoch_key=ek0,
-            )
+            P = _max_pass(max_position, spec, s)
+            nw_s, tail_s = n_s // W_s, n_s % W_s
+            n_lanes = int(np.prod(p.shape))  # static under jit
+            if (
+                P is not None
+                and P * (nw_s + tail_s) <= _TABLE_CAP
+                # table construction must pay for itself: don't build
+                # O(P*nw) tables to answer a handful of random-access
+                # probes (the per-lane path is O(1) per probe)
+                and P * (nw_s + tail_s) <= 4 * n_lanes
+            ):
+                idx = _amortized_source_perm(
+                    xp, u.astype(src_pos_dtype), pas, n_s, W_s, seed_pair,
+                    epoch, P, order_windows, rounds, src_pos_dtype,
+                )
+            else:
+                # §8.3 pass-folded epoch, per lane (pass varies along the
+                # batch); pairing keys from the pass-FREE key so the
+                # swap-or-not K_r '% m' hoist survives
+                ep = core.as_u32_scalar(xp, epoch)
+                ep_u = core.mix32(
+                    xp, ep ^ core.mix32(xp, pas ^ core._u32(xp, _C_PASS))
+                )
+                ek = core.derive_epoch_key(xp, seed_pair, ep_u)
+                ek0 = core.derive_epoch_key(xp, seed_pair, ep)
+                idx = core.windowed_perm(
+                    xp, u, n_s, W_s, ek,
+                    order_windows=order_windows, rounds=rounds,
+                    pos_dtype=src_pos_dtype,
+                    pair_epoch_key=ek0,
+                )
         else:
             idx = u
         gid = xp.asarray(spec.bases[s], dtype=out_dtype) \
             + idx.astype(out_dtype)
         out = xp.where(s_arr == xp.asarray(s, dtype=s_arr.dtype), gid, out)
     return out
+
+
+def _max_pass(max_position: Optional[int], spec: MixtureSpec,
+              s: int) -> Optional[int]:
+    """Static upper bound on a source's pass counter over positions
+    ``<= max_position``: ``j <= (pmax // B) * k_s + k_s - 1``."""
+    if max_position is None:
+        return None
+    j_max = (int(max_position) // spec.block) * spec.quotas[s] \
+        + spec.quotas[s] - 1
+    return j_max // spec.sources[s] + 1
 
 
 def source_seed_folded(seed, s: int):
@@ -244,24 +380,6 @@ def source_seed_folded(seed, s: int):
     else:
         hi = hi ^ np.uint32(d_hi)
     return (lo, hi)
-
-
-def _needs_big_positions(positions, spec: MixtureSpec) -> bool:
-    """uint64 position math when positions (or per-source draw counts)
-    can exceed uint32.  Conservative static bound: the caller's max
-    position; per-source j is <= position + B.  Concrete arrays only —
-    a traced array must carry the (static) flag from its caller."""
-    try:
-        arr = np.asarray(positions)
-    except Exception:
-        arr = None
-    if arr is None or arr.dtype == object:
-        raise TypeError(
-            "big_positions must be passed explicitly for traced position "
-            "arrays (it selects the static position dtype)"
-        )
-    pmax = int(arr.max()) if arr.size else 0
-    return pmax + spec.block >= 0x7FFFFFFF
 
 
 def mixture_epoch_sizes(
@@ -290,6 +408,7 @@ def mixture_epoch_indices_generic(
     order_windows: bool = True,
     partition: str = "strided",
     rounds: int = core.DEFAULT_ROUNDS,
+    amortize: bool = True,
 ):
     """Rank's mixture-epoch global ids (§8.4).
 
@@ -315,6 +434,7 @@ def mixture_epoch_indices_generic(
         xp, p, spec, seed, epoch,
         shuffle=shuffle, order_windows=order_windows, rounds=rounds,
         big_positions=(pos_dtype == xp.uint64),
+        amortize=amortize, max_position=total - 1,
     )
 
 
@@ -343,6 +463,7 @@ def mixture_epoch_indices_jax(spec, seed, epoch, rank, world, **kw):
         kw.pop("shuffle", True), kw.pop("drop_last", False),
         kw.pop("order_windows", True), kw.pop("partition", "strided"),
         kw.pop("rounds", core.DEFAULT_ROUNDS),
+        kw.pop("amortize", True),
     )
     if kw:
         raise TypeError(f"unexpected kwargs: {sorted(kw)}")
@@ -362,15 +483,16 @@ def mixture_epoch_indices_jax(spec, seed, epoch, rank, world, **kw):
 
 @functools.lru_cache(maxsize=64)
 def _compiled_mixture(spec_key, world, epoch_samples, shuffle,
-                      drop_last, order_windows, partition, rounds):
+                      drop_last, order_windows, partition, rounds,
+                      amortize=True):
     import jax
     import jax.numpy as jnp
 
     sources, weights, windows, block = spec_key
     spec = MixtureSpec(sources, weights, windows=list(windows), block=block)
 
-    # seed is concrete (per-source fold needs the wide int) -> one
-    # executable per seed value; epoch and rank are traced
+    # one executable per concrete seed (the cache comment in
+    # mixture_epoch_indices_jax explains the choice); epoch/rank traced
     @functools.lru_cache(maxsize=8)
     def for_seed(seed: int):
         @jax.jit
@@ -379,7 +501,7 @@ def _compiled_mixture(spec_key, world, epoch_samples, shuffle,
                 jnp, spec, seed, epoch, rank, world,
                 epoch_samples=epoch_samples, shuffle=shuffle,
                 drop_last=drop_last, order_windows=order_windows,
-                partition=partition, rounds=rounds,
+                partition=partition, rounds=rounds, amortize=amortize,
             )
 
         return fn

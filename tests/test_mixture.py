@@ -207,6 +207,52 @@ def test_jax_executable_reused_across_epochs_and_ranks():
     assert f1 is f2  # lru-cached per config
 
 
+@pytest.mark.parametrize("sizes,windows", [
+    (SIZES, 64),
+    ([7, 1000, 13], [7, 64, 13]),     # W == n (pure tail) sources
+    ([97, 31], 10),                   # tails everywhere
+    ([64, 128], [64, 32]),            # no tails
+    ([5, 2000], 1),                   # W=1: only window order moves
+])
+@pytest.mark.parametrize("order_windows", [True, False])
+def test_amortized_evaluator_bit_identical(sizes, windows, order_windows):
+    """The table-based evaluator is an evaluation strategy, not a law
+    change: amortize=True == amortize=False bit-for-bit, across pure-tail,
+    no-tail, W=1, per-source-window, and multi-pass configs."""
+    spec = M.MixtureSpec(sizes, [3] * len(sizes), windows=windows, block=32)
+    for world, rank in [(1, 0), (3, 2)]:
+        a = M.mixture_epoch_indices_np(
+            spec, 9, 4, rank, world, order_windows=order_windows,
+            amortize=True)
+        b = M.mixture_epoch_indices_np(
+            spec, 9, 4, rank, world, order_windows=order_windows,
+            amortize=False)
+        assert np.array_equal(a, b), (sizes, windows, order_windows, world)
+
+
+def test_amortized_fallback_over_table_cap(monkeypatch):
+    """A table blowing the cap silently falls back to the per-lane path —
+    same values.  The cap is forced down so the fallback branch actually
+    executes (at the real cap this spec's tables are tiny)."""
+    spec = M.MixtureSpec([4, 50], [19, 1], windows=2, block=20)
+    a = M.mixture_epoch_indices_np(spec, 1, 0, 0, 1, amortize=True)
+    monkeypatch.setattr(M, "_TABLE_CAP", 1)  # every table now over-cap
+    b = M.mixture_epoch_indices_np(spec, 1, 0, 0, 1, amortize=True)
+    c = M.mixture_epoch_indices_np(spec, 1, 0, 0, 1, amortize=False)
+    assert np.array_equal(a, b) and np.array_equal(b, c)
+
+
+def test_amortize_skipped_for_tiny_probe_queries():
+    """Random access with a handful of probes must not build O(P*nw)
+    tables (the gate requires table work <= 4x the lane count); values
+    are identical either way, so assert via the law."""
+    spec = M.MixtureSpec([10**6], [1], windows=64)
+    probes = np.asarray([500_000_000])  # max_position huge, 1 lane
+    a = M.mixture_stream_at_np(probes, spec, 3, 0)
+    b = M.mixture_stream_at_np(probes, spec, 3, 0, amortize=False)
+    assert np.array_equal(a, b)
+
+
 # ------------------------------------------------------- mesh/ICI path
 def test_sharded_mixture_matches_numpy_per_rank():
     from partiallyshuffledistributedsampler_tpu.parallel import (
