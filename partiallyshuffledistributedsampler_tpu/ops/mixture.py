@@ -438,6 +438,121 @@ def mixture_epoch_indices_generic(
     )
 
 
+def mixture_elastic_indices_generic(
+    xp: Any,
+    spec: MixtureSpec,
+    seed,
+    epoch,
+    rank,
+    world: int,
+    layers,
+    *,
+    epoch_samples: Optional[int] = None,
+    shuffle: bool = True,
+    drop_last: bool = False,
+    order_windows: bool = True,
+    partition: str = "strided",
+    rounds: int = core.DEFAULT_ROUNDS,
+    amortize: bool = True,
+):
+    """Elastic remainder-epoch mixture stream (SPEC.md §6 over the §8
+    stream).  The §6 law is stream-agnostic — it maps remainder ordinals
+    to base-epoch *positions*; here those positions evaluate through the
+    mixture stream instead of the single-source one.  ``layers`` is the
+    checkpoint cascade ``[(world, consumed), ...]`` outermost first,
+    exactly as in ``ops.cpu.elastic_indices_np``.
+    """
+    T = spec.total_sources_len if epoch_samples is None else int(epoch_samples)
+    chain, remaining, num_samples = core.elastic_chain(
+        T, layers, world, drop_last
+    )
+    out_dtype = (
+        xp.int32 if spec.total_sources_len <= 0x7FFFFFFF else xp.int64
+    )
+    if remaining == 0 or num_samples == 0:
+        return xp.zeros(0, dtype=out_dtype)
+    # base-epoch positions are bounded by layer 0's total
+    base_total = chain[0][1] * chain[0][0]  # ns_0 * world_0
+    pos_dtype = (
+        xp.uint32 if base_total + spec.block <= 0x7FFFFFFF else xp.uint64
+    )
+    q = core.rank_positions(
+        xp, remaining, rank, world, num_samples, partition, pos_dtype
+    )
+    pos = core.compose_remainder_chain(xp, q, chain, partition, pos_dtype)
+    return mixture_stream_at_generic(
+        xp, pos, spec, seed, epoch,
+        shuffle=shuffle, order_windows=order_windows, rounds=rounds,
+        big_positions=(pos_dtype == xp.uint64),
+        amortize=amortize, max_position=base_total - 1,
+    )
+
+
+def mixture_elastic_indices_np(spec, seed, epoch, rank, world, layers, **kw):
+    """numpy frontend of the elastic mixture remainder stream."""
+    return mixture_elastic_indices_generic(
+        np, spec, seed, epoch, rank, world, layers, **kw
+    )
+
+
+def mixture_elastic_indices_jax(spec, seed, epoch, rank, world, layers,
+                                **kw):
+    """Jitted device frontend of the elastic mixture remainder stream —
+    cached per (spec, world, cascade, flags) like the epoch frontend;
+    ``epoch``/``rank`` traced, the cascade static."""
+    import jax
+
+    layers_key = tuple((int(w), int(c)) for w, c in layers)
+    fn = _compiled_mixture_elastic(
+        spec.key(), int(world), layers_key,
+        kw.pop("epoch_samples", None),
+        kw.pop("shuffle", True), kw.pop("drop_last", False),
+        kw.pop("order_windows", True), kw.pop("partition", "strided"),
+        kw.pop("rounds", core.DEFAULT_ROUNDS),
+        kw.pop("amortize", True),
+    )
+    if kw:
+        raise TypeError(f"unexpected kwargs: {sorted(kw)}")
+    if not isinstance(seed, (int, np.integer)):
+        raise TypeError(
+            "this frontend takes concrete int seeds (see "
+            "mixture_epoch_indices_jax)"
+        )
+    import jax.numpy as jnp
+
+    return fn(
+        int(seed),
+        core.as_u32_scalar(jnp, epoch),
+        core.as_u32_scalar(jnp, rank),
+    )
+
+
+@functools.lru_cache(maxsize=64)
+def _compiled_mixture_elastic(spec_key, world, layers_key, epoch_samples,
+                              shuffle, drop_last, order_windows, partition,
+                              rounds, amortize):
+    import jax
+    import jax.numpy as jnp
+
+    sources, weights, windows, block = spec_key
+    spec = MixtureSpec(sources, weights, windows=list(windows), block=block)
+
+    @functools.lru_cache(maxsize=8)
+    def for_seed(seed: int):
+        @jax.jit
+        def fn(epoch, rank):
+            return mixture_elastic_indices_generic(
+                jnp, spec, seed, epoch, rank, world, list(layers_key),
+                epoch_samples=epoch_samples, shuffle=shuffle,
+                drop_last=drop_last, order_windows=order_windows,
+                partition=partition, rounds=rounds, amortize=amortize,
+            )
+
+        return fn
+
+    return lambda seed, epoch, rank: for_seed(seed)(epoch, rank)
+
+
 # ---------------------------------------------------------------- frontends
 
 def mixture_epoch_indices_np(spec, seed, epoch, rank, world, **kw):

@@ -25,11 +25,17 @@ from ..ops import core
 from ..ops.mixture import (
     DEFAULT_BLOCK,
     MixtureSpec,
+    mixture_elastic_indices_np,
     mixture_epoch_indices_np,
     mixture_epoch_sizes,
 )
 from ._chunked_iter import ChunkedIterMixin
-from .torch_shim import SPEC_VERSION, _resolve_identity, _TorchSampler
+from .torch_shim import (
+    SPEC_VERSION,
+    _elastic_layers_from_state,
+    _resolve_identity,
+    _TorchSampler,
+)
 
 
 class PartialShuffleMixtureSampler(ChunkedIterMixin, _TorchSampler):
@@ -105,6 +111,7 @@ class PartialShuffleMixtureSampler(ChunkedIterMixin, _TorchSampler):
         self._offset = 0
         self._consumed = 0
         self._generation = 0
+        self._elastic = None  # remainder-epoch state after a world change
         self._pending = None
         self._pending_epoch: Optional[int] = None
         from ..utils.metrics import RegenTimer
@@ -130,6 +137,10 @@ class PartialShuffleMixtureSampler(ChunkedIterMixin, _TorchSampler):
     def epoch_indices(self, epoch: Optional[int] = None) -> np.ndarray:
         """This rank's global-id order for ``epoch`` (default: current)."""
         e = self.epoch if epoch is None else int(epoch)
+        # the elastic remainder regime applies only to the epoch being
+        # resumed; an explicit other epoch is an ordinary full epoch
+        if self._elastic is not None and e == self.epoch:
+            return self._elastic_indices(e)
         with self.regen_timer.measure():
             if self.backend == "xla":
                 if self._pending_epoch == e and self._pending is not None:
@@ -147,19 +158,122 @@ class PartialShuffleMixtureSampler(ChunkedIterMixin, _TorchSampler):
         """(source_id, local_id) arrays for served global ids."""
         return self.spec.decompose(global_ids)
 
+    # ------------------------------------------------------ elastic reshard
+    # NOTE: this block intentionally mirrors torch_shim's elastic plumbing
+    # (_compute_elastic/_elastic_indices/reshard_from_state_dict); the two
+    # evaluate different streams (§4 vs §8) through the same §6 law, so the
+    # *shape* of the logic is shared but the core calls differ.  A fix to
+    # the validate-before-mutate ordering, the cache rule, or the cascade
+    # append must be applied to BOTH samplers.
+    def _compute_elastic(self, layers) -> dict:
+        """Size/validate a reshard cascade over the mixture-epoch length
+        (SPEC.md §6 over the §8 stream); pure, mirrors the single-source
+        shim so callers validate before mutating."""
+        chain, remaining, num_samples = core.elastic_chain(
+            self.T, layers, self.num_replicas, self.drop_last
+        )
+        return {
+            "layers": [(w, c) for (w, _ns, c) in chain],
+            "remaining": remaining,
+            "num_samples": num_samples,
+        }
+
+    def _elastic_indices(self, epoch: int) -> np.ndarray:
+        # epoch-keyed read-only cache, mirroring torch_shim._elastic_indices
+        # (the single-source sibling) — a change to either cache rule must
+        # be applied to both
+        el = self._elastic
+        cached = el.get("_cache")
+        if cached is not None and cached[0] == epoch:
+            return cached[1]
+        kw = dict(
+            epoch_samples=self.epoch_samples, shuffle=self.shuffle,
+            drop_last=self.drop_last, order_windows=self.order_windows,
+            partition=self.partition, rounds=self.rounds,
+        )
+        with self.regen_timer.measure():
+            if self.backend == "xla":
+                from ..ops.mixture import mixture_elastic_indices_jax
+
+                arr = np.asarray(mixture_elastic_indices_jax(
+                    self.spec, self.seed, epoch, self.rank,
+                    self.num_replicas, el["layers"], **kw,
+                ))
+            else:
+                arr = mixture_elastic_indices_np(
+                    self.spec, self.seed, epoch, self.rank,
+                    self.num_replicas, el["layers"], **kw,
+                )
+        arr.setflags(write=False)
+        el["_cache"] = (epoch, arr)
+        return arr
+
+    @classmethod
+    def reshard_from_state_dict(cls, state: dict, num_replicas: int,
+                                rank: int, **kwargs):
+        """Resume a mixture checkpoint at a different world size: the
+        current epoch's un-consumed mixture stream — and only that — is
+        served this epoch, split across the new ranks (SPEC.md §6 over
+        §8); from the next ``set_epoch`` on, an ordinary sampler."""
+        if state.get("kind") != "mixture":
+            raise ValueError(
+                f"checkpoint kind {state.get('kind')!r} is not a mixture "
+                "checkpoint"
+            )
+        if state.get("spec_version", SPEC_VERSION) != SPEC_VERSION:
+            raise ValueError(
+                f"checkpoint from spec version {state['spec_version']}, "
+                f"this build implements {SPEC_VERSION}"
+            )
+        for f in ("sources", "weights", "num_replicas", "offset", "seed",
+                  "epoch"):
+            if f not in state:
+                raise ValueError(f"state_dict lacks {f!r}")
+        sampler = cls(
+            list(state["sources"]), list(state["weights"]),
+            num_replicas=num_replicas, rank=rank,
+            seed=int(state["seed"]),
+            windows=list(state.get("windows")) if state.get("windows")
+            else None,
+            block=int(state.get("block", DEFAULT_BLOCK)),
+            epoch_samples=state.get("epoch_samples"),
+            shuffle=state.get("shuffle", True),
+            drop_last=state.get("drop_last", False),
+            order_windows=state.get("order_windows", True),
+            partition=state.get("partition", "strided"),
+            rounds=int(state.get("rounds", core.DEFAULT_ROUNDS)),
+            **kwargs,
+        )
+        sampler.epoch = int(state["epoch"])
+        layers = _elastic_layers_from_state(state.get("elastic")) or []
+        layers = layers + [(int(state["num_replicas"]), int(state["offset"]))]
+        sampler._elastic = sampler._compute_elastic(layers)
+        sampler._pending = None
+        sampler._pending_epoch = None
+        return sampler
+
     # ---------------------------------------------------------- Sampler API
     # __iter__ from ChunkedIterMixin (shared with the single-source shim)
 
+    @property
+    def _effective_num_samples(self) -> int:
+        if self._elastic is not None:
+            return self._elastic["num_samples"]
+        return self.num_samples
+
     def __len__(self) -> int:
-        return self.num_samples - self._offset
+        return self._effective_num_samples - self._offset
 
     def set_epoch(self, epoch: int) -> None:
         e = int(epoch)
         if e != self.epoch:
             self._generation += 1
+            self._elastic = None  # the remainder regime ends with its epoch
             self._offset = 0
             self._consumed = 0
         self.epoch = e
+        if self._elastic is not None:
+            return  # remainder epoch regenerates on demand in __iter__
         if self.backend == "xla":
             self._pending = self._generate_device(e)
             self._pending_epoch = e
@@ -190,6 +304,10 @@ class PartialShuffleMixtureSampler(ChunkedIterMixin, _TorchSampler):
         }
         for f in self._CONFIG_FIELDS:
             state[f] = getattr(self, f)
+        if self._elastic is not None:
+            state["elastic"] = {
+                "layers": [[w, c] for (w, c) in self._elastic["layers"]],
+            }
         return state
 
     def load_state_dict(self, state: dict) -> None:
@@ -227,13 +345,17 @@ class PartialShuffleMixtureSampler(ChunkedIterMixin, _TorchSampler):
                     f"checkpoint was written with {f}={state[f]!r} but this "
                     f"sampler has {f}={getattr(self, f)!r}"
                 )
+        # validate everything before assigning anything (failed load must
+        # leave the sampler untouched), incl. a remainder-epoch cascade
+        layers = _elastic_layers_from_state(state.get("elastic"))
+        elastic = self._compute_elastic(layers) if layers else None
+        effective = elastic["num_samples"] if elastic else self.num_samples
         offset = int(state.get("offset", 0))
-        if not (0 <= offset <= self.num_samples):
-            raise ValueError(
-                f"offset {offset} outside [0, {self.num_samples}]"
-            )
+        if not (0 <= offset <= effective):
+            raise ValueError(f"offset {offset} outside [0, {effective}]")
         self.seed = int(state["seed"])
         self.epoch = int(state["epoch"])
+        self._elastic = elastic
         self._pending = None
         self._pending_epoch = None
         self._offset = offset

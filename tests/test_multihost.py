@@ -63,6 +63,21 @@ _WORKER = textwrap.dedent("""
         ref = cpu.elastic_indices_np(n, w, seed, epoch, r, 8, layers)
         np.testing.assert_array_equal(np.asarray(shard.data)[0], ref)
 
+    # weighted mixture (SPEC.md §8) across the same process boundary:
+    # per-source seeds derive from the ICI-agreed triple in-program
+    from partiallyshuffledistributedsampler_tpu.ops.mixture import (
+        MixtureSpec, mixture_epoch_indices_np)
+    from partiallyshuffledistributedsampler_tpu.parallel import (
+        sharded_mixture_indices)
+
+    spec = MixtureSpec([5000, 2000, 1000], [5, 3, 2], windows=64, block=80)
+    mout = sharded_mixture_indices(mesh, spec, seed, epoch,
+                                   local_seeds=local)
+    for shard in mout.addressable_shards:
+        r = shard.index[0].start or 0
+        ref = mixture_epoch_indices_np(spec, seed, epoch, r, 8)
+        np.testing.assert_array_equal(np.asarray(shard.data)[0], ref)
+
     print(f"MULTIHOST_OK pid={pid} rows=" +
           ",".join(str(s.index[0].start or 0) for s in out.addressable_shards))
 """)
