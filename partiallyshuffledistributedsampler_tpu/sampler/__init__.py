@@ -1,7 +1,11 @@
 """User-facing samplers: the torch shim, JAX-native iterators, shard mode."""
 
 from .host_loader import HostDataLoader  # noqa: F401
-from .jax_iterator import DeviceEpochIterator, batch_index_window  # noqa: F401
+from .jax_iterator import (  # noqa: F401
+    DeviceEpochIterator,
+    MixtureEpochIterator,
+    batch_index_window,
+)
 from .mixture import PartialShuffleMixtureSampler  # noqa: F401
 from .shard_mode import (  # noqa: F401
     PartialShuffleShardSampler,

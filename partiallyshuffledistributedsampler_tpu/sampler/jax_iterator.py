@@ -406,3 +406,89 @@ class DeviceEpochIterator:
         )
         carry, ys = runner(carry, jnp.int32(first_epoch))
         return (carry, ys) if collect else carry
+
+
+class MixtureEpochIterator(DeviceEpochIterator):
+    """:class:`DeviceEpochIterator` over a weighted mixture (SPEC.md §8).
+
+        it = MixtureEpochIterator(spec, batch=512, seed=0, rank=r, world=w)
+        for epoch in range(E):
+            state, losses = it.run_epoch(epoch, step, state, collect=True)
+
+    Same drive modes and contracts as the single-source iterator —
+    ``epoch()`` (chunked unstack + next-epoch prefetch), ``run_epoch``
+    (whole epoch, one compiled program), ``elastic_epoch`` (remainder
+    after a world change, via the §6-over-§8 law) — with the epoch index
+    tensor holding mixture *global ids* (``spec.decompose`` splits them).
+    The §4/§8.4 length laws coincide, so all sizing plumbing is inherited.
+
+    ``run_epochs`` (regen traced in-program) is NOT available: it fuses
+    the single-source evaluator; drive mixtures epoch-by-epoch with
+    ``run_epoch`` (one dispatch each, regen prefetched behind the
+    previous epoch).
+    """
+
+    def __init__(
+        self,
+        spec,
+        batch: int,
+        *,
+        seed: int = 0,
+        rank: int = 0,
+        world: int = 1,
+        epoch_samples: Optional[int] = None,
+        drop_last_batch: bool = True,
+        prefetch_next_epoch: bool = True,
+        **kwargs,
+    ) -> None:
+        from ..ops.mixture import MixtureSpec, mixture_epoch_sizes
+
+        if not isinstance(spec, MixtureSpec):
+            raise TypeError(
+                f"spec must be a MixtureSpec, got {type(spec).__name__}"
+            )
+        self.spec = spec
+        self.epoch_samples = (
+            None if epoch_samples is None else int(epoch_samples)
+        )
+        T, _, _ = mixture_epoch_sizes(
+            spec, epoch_samples, world, kwargs.get("drop_last", False)
+        )
+        # window is per-source state carried by the spec; the base-class
+        # field is unused for mixtures (n=T drives all sizing, which is
+        # the same §4 law)
+        super().__init__(
+            T, 1, batch, seed=seed, rank=rank, world=world,
+            drop_last_batch=drop_last_batch,
+            prefetch_next_epoch=prefetch_next_epoch, **kwargs,
+        )
+
+    def _regen(self, epoch: int) -> jax.Array:
+        from ..ops.mixture import mixture_epoch_indices_jax
+
+        return mixture_epoch_indices_jax(
+            self.spec, self.seed, epoch, self.rank, self.world,
+            epoch_samples=self.epoch_samples, **self.kwargs,
+        )
+
+    def elastic_epoch_array(self, epoch: int, layers) -> jax.Array:
+        from ..ops.mixture import mixture_elastic_indices_jax
+
+        chain, remaining, ns = core.elastic_chain(
+            self.n, layers, self.world, self.kwargs.get("drop_last", False)
+        )
+        if remaining == 0 or ns == 0:
+            dtype = (jnp.int32 if self.spec.total_sources_len <= 0x7FFFFFFF
+                     else jnp.int64)
+            return jnp.empty((0,), dtype)
+        return mixture_elastic_indices_jax(
+            self.spec, self.seed, epoch, self.rank, self.world, layers,
+            epoch_samples=self.epoch_samples, **self.kwargs,
+        )
+
+    def run_epochs(self, *args, **kwargs):
+        raise NotImplementedError(
+            "run_epochs fuses the single-source in-program evaluator; "
+            "drive mixtures epoch-by-epoch with run_epoch (regen is "
+            "prefetched behind the previous epoch either way)"
+        )

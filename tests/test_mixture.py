@@ -301,6 +301,39 @@ def test_wide_seed_half_decomposition():
     assert np.array_equal(a, b)
 
 
+# ------------------------------------------------- device iterator
+def test_mixture_epoch_iterator_serves_the_stream():
+    import jax.numpy as jnp
+
+    from partiallyshuffledistributedsampler_tpu.sampler import (
+        MixtureEpochIterator,
+    )
+
+    spec = make_spec()
+    it = MixtureEpochIterator(spec, batch=64, seed=7, rank=1, world=2)
+    ref = M.mixture_epoch_indices_np(spec, 7, 3, 1, 2)
+    got = np.concatenate([np.asarray(b) for b in it.epoch(3)])
+    whole = (len(ref) // 64) * 64
+    assert np.array_equal(got, ref[:whole])  # drop_last_batch default
+    # run_epoch: whole epoch, one compiled program, same values
+    def step(c, b):
+        return (c[0] + 1, c[1] + b.sum()), b[0]
+
+    (steps_done, total), firsts = it.run_epoch(
+        3, step, (jnp.int32(0), jnp.int64(0)), collect=True)
+    assert int(steps_done) == len(ref) // 64
+    assert int(total) == int(ref[:whole].sum())
+    # elastic remainder epoch through the iterator
+    el = np.concatenate([np.asarray(b)
+                         for b in it.elastic_epoch(3, [(2, 100)])])
+    eref = M.mixture_elastic_indices_np(spec, 7, 3, 1, 2, [(2, 100)])
+    assert np.array_equal(el, eref[:(len(eref) // 64) * 64])
+    with pytest.raises(NotImplementedError, match="run_epochs"):
+        it.run_epochs(0, 2, step, 0)
+    with pytest.raises(TypeError, match="MixtureSpec"):
+        MixtureEpochIterator([1000], batch=8)
+
+
 # ------------------------------------------------- elastic (§6 over §8)
 def test_mixture_elastic_matches_hand_rolled_position_law():
     """Single-layer strided reshard: the remainder stream must equal the
