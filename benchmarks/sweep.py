@@ -104,6 +104,33 @@ def main() -> None:
                         "backend": name, "n": n, "error": repr(exc)[:150]
                     }), flush=True)
 
+    # mixture stream (SPEC.md §8): a 70/20/10 3-corpus blend at each scale,
+    # both evaluators, device wall per epoch — the reproducible home of the
+    # figures BASELINE.md's round-4 notes quote
+    from partiallyshuffledistributedsampler_tpu.ops.mixture import (
+        MixtureSpec, mixture_epoch_indices_jax,
+    )
+
+    for n in scales:
+        parts = [n * 7 // 10, n * 2 // 10, n - n * 7 // 10 - n * 2 // 10]
+        spec = MixtureSpec(parts, [70, 20, 10], windows=min(WINDOW, parts[-1]))
+        for label, am in (("mixture_amortized", True),
+                          ("mixture_general", False)):
+            try:
+                ms = _steady_ms_device(
+                    lambda e, spec=spec, am=am: mixture_epoch_indices_jax(
+                        spec, 0, e, 0, WORLD, amortize=am
+                    )
+                )
+                print(json.dumps({
+                    "backend": label, "n": n, "world": WORLD,
+                    "per_epoch_ms": round(ms, 3),
+                }), flush=True)
+            except Exception as exc:
+                print(json.dumps({
+                    "backend": label, "n": n, "error": repr(exc)[:150]
+                }), flush=True)
+
 
 if __name__ == "__main__":
     main()
