@@ -226,6 +226,68 @@ def test_custom_batch_sampler_without_batch_size_needs_override():
     assert loader2.state_dict()["sampler"]["offset"] == 16
 
 
+def test_works_over_mixture_sampler():
+    """The mixture sampler exposes the same checkpoint surface, so the
+    exact-resume law must hold through StatefulDataLoader for it too."""
+    from partiallyshuffledistributedsampler_tpu.sampler import (
+        PartialShuffleMixtureSampler,
+    )
+
+    sizes, weights = [200, 80, 53], [3, 2, 1]
+    total = sum(sizes)
+    ds = TensorDataset(torch.arange(total))
+
+    def make_mix():
+        s = PartialShuffleMixtureSampler(
+            sizes, weights, num_replicas=2, rank=0, windows=16, block=12)
+        s.set_epoch(1)
+        return s
+
+    ref = [b[0].tolist() for b in
+           StatefulDataLoader(ds, batch_size=16, sampler=make_mix(),
+                              num_workers=2)]
+    loader = StatefulDataLoader(ds, batch_size=16, sampler=make_mix(),
+                                num_workers=2)
+    seen, state = [], None
+    for i, b in enumerate(loader):
+        seen.append(b[0].tolist())
+        state = loader.state_dict()
+        if i == 2:
+            break
+    loader2 = StatefulDataLoader(ds, batch_size=16, sampler=make_mix(),
+                                 num_workers=2)
+    loader2.load_state_dict(state)
+    assert seen + [b[0].tolist() for b in loader2] == ref
+
+
+def test_works_over_shard_sampler():
+    from partiallyshuffledistributedsampler_tpu.sampler import (
+        PartialShuffleShardSampler,
+    )
+
+    num_shards = 96
+    ds = TensorDataset(torch.arange(num_shards))
+
+    def make_shard():
+        s = PartialShuffleShardSampler(
+            num_shards, num_replicas=2, rank=0, window=8, backend="cpu")
+        s.set_epoch(2)
+        return s
+
+    ref = [b[0].tolist() for b in
+           StatefulDataLoader(ds, batch_size=8, sampler=make_shard())]
+    loader = StatefulDataLoader(ds, batch_size=8, sampler=make_shard())
+    seen, state = [], None
+    for i, b in enumerate(loader):
+        seen.append(b[0].tolist())
+        state = loader.state_dict()
+        if i == 1:
+            break
+    loader2 = StatefulDataLoader(ds, batch_size=8, sampler=make_shard())
+    loader2.load_state_dict(state)
+    assert seen + [b[0].tolist() for b in loader2] == ref
+
+
 def test_load_accepts_bare_sampler_state():
     s = make_sampler()
     s.set_epoch(7)
