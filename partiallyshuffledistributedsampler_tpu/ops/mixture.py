@@ -52,6 +52,15 @@ class MixtureSpec:
 
     Raises when a positive-weight source would starve (``k_s == 0``),
     naming a block size sufficient to serve it.
+
+    .. note:: **Per-rank balance under strided partition.**  A strided
+       rank's positions hit pattern slots ``(rank + world*k) mod B``,
+       which is only ``B / gcd(world, B)`` distinct slots — if
+       ``gcd(world, B)`` is large, a rank's *own* source mix can skew
+       arbitrarily (an unlucky rank may never see a small source) even
+       though the global stream is exact.  Pick ``block`` coprime to the
+       world size (or use ``partition='blocked'``, whose contiguous
+       positions cover whole blocks) when per-rank balance matters.
     """
 
     def __init__(
@@ -502,6 +511,12 @@ def mixture_elastic_indices_jax(spec, seed, epoch, rank, world, layers,
     ``epoch``/``rank`` traced, the cascade static."""
     import jax
 
+    T = (spec.total_sources_len if kw.get("epoch_samples") is None
+         else int(kw["epoch_samples"]))
+    chain, _rem, _ns = core.elastic_chain(
+        T, layers, int(world), kw.get("drop_last", False)
+    )
+    _require_x64_for_big_mixture(spec, chain[0][1] * chain[0][0])
     layers_key = tuple((int(w), int(c)) for w, c in layers)
     fn = _compiled_mixture_elastic(
         spec.key(), int(world), layers_key,
@@ -566,11 +581,34 @@ def mixture_stream_at_np(positions, spec, seed, epoch, **kw):
     return mixture_stream_at_generic(np, positions, spec, seed, epoch, **kw)
 
 
+def _require_x64_for_big_mixture(spec: MixtureSpec, total: int) -> None:
+    """A mixture whose id space or position space reaches 2^31 needs
+    int64/uint64 under jax; without x64 jnp silently demotes and returns
+    wrong ids — refuse loudly (the single-source guard's §8 counterpart,
+    ops.xla._require_x64_for_big_n)."""
+    import jax
+
+    if (
+        spec.total_sources_len > 0x7FFFFFFF
+        or total + spec.block > 0x7FFFFFFF
+    ) and not jax.config.read("jax_enable_x64"):
+        raise ValueError(
+            "mixtures with >= 2^31 total ids or positions need 64-bit "
+            "math: enable x64 (enable_big_index_space())"
+        )
+
+
 def mixture_epoch_indices_jax(spec, seed, epoch, rank, world, **kw):
     """Jitted device frontend — one compiled program per
     ``(spec.key(), world, flags)``, reused across epochs and ranks
     (``epoch``/``rank`` are traced)."""
     import jax
+
+    T, _, total = mixture_epoch_sizes(
+        spec, kw.get("epoch_samples"), int(world),
+        kw.get("drop_last", False),
+    )
+    _require_x64_for_big_mixture(spec, total)
 
     fn = _compiled_mixture(
         spec.key(), int(world),

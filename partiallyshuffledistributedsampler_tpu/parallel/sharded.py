@@ -253,11 +253,15 @@ def _compiled_sharded_mixture(
     over the agreed (lo, hi) halves, so it runs on the traced triple with
     no host involvement (ops.mixture.source_seed_folded)."""
     from ..ops.mixture import (
-        MixtureSpec, mixture_epoch_indices_generic,
+        MixtureSpec, _require_x64_for_big_mixture,
+        mixture_epoch_indices_generic, mixture_epoch_sizes,
     )
 
     sources, weights, windows, block = spec_key
     spec = MixtureSpec(sources, weights, windows=list(windows), block=block)
+    _t, _ns, total = mixture_epoch_sizes(spec, epoch_samples, world,
+                                         drop_last)
+    _require_x64_for_big_mixture(spec, total)
 
     def per_device(local_triple):
         rank = jax.lax.axis_index(axis)

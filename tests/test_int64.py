@@ -159,3 +159,42 @@ def test_device_shard_expansion_big_total_without_x64_raises():
         pytest.skip("x64 already on in this process")
     with pytest.raises(ValueError, match="x64"):
         expand_shard_indices_jax([3], [1_000_000_000] * 3 + [64])
+
+
+def test_mixture_numpy_path_int64():
+    """Mixture over a >2^31 total id space: int64 out, per-source locality
+    preserved, high ids actually reached (numpy path needs no flag)."""
+    from partiallyshuffledistributedsampler_tpu.ops import mixture as M
+
+    # world coprime to the block so the strided rank samples every pattern
+    # slot (see the per-rank balance note in MixtureSpec's docstring)
+    spec = M.MixtureSpec([3_000_000_000, 1_000_000_000], [3, 1],
+                         windows=8192)
+    idx = M.mixture_epoch_indices_np(spec, 7, 1, 5, 1_999_999)
+    assert idx.dtype == np.int64
+    assert idx.max() > 2**31
+    src, loc = spec.decompose(idx)
+    assert loc[src == 0].max() < 3_000_000_000
+    assert loc[src == 1].max() < 1_000_000_000
+
+
+def test_mixture_jax_refuses_big_ids_without_x64():
+    """Without x64, jnp silently demotes int64 — the frontends must refuse
+    loudly for >=2^31 mixtures instead (the §8 counterpart of
+    ops.xla._require_x64_for_big_n).  This process has x64 off."""
+    import jax
+
+    from partiallyshuffledistributedsampler_tpu.ops import mixture as M
+    from partiallyshuffledistributedsampler_tpu.parallel import (
+        data_mesh, sharded_mixture_indices,
+    )
+
+    assert not jax.config.read("jax_enable_x64")
+    spec = M.MixtureSpec([3_000_000_000, 1_000_000_000], [3, 1],
+                         windows=8192)
+    with pytest.raises(ValueError, match="x64"):
+        M.mixture_epoch_indices_jax(spec, 7, 1, 5, 1_999_999)
+    with pytest.raises(ValueError, match="x64"):
+        M.mixture_elastic_indices_jax(spec, 7, 1, 0, 2, [(2_000_000, 100)])
+    with pytest.raises(ValueError, match="x64"):
+        sharded_mixture_indices(data_mesh(), spec, 7, 1)
