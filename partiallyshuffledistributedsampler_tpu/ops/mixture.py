@@ -162,6 +162,39 @@ class MixtureSpec:
         s = np.searchsorted(bases, gids, side="right") - 1
         return s.astype(np.int32), gids - bases[s]
 
+    def rank_slot_counts(self, rank: int, world: int) -> np.ndarray:
+        """Per-source counts over the pattern slots a STRIDED rank visits
+        (its orbit ``(rank + world*k) mod B``, visited uniformly).  The
+        rank's realized long-run mix is ``counts / counts.sum()`` — exact,
+        cheap (<= B work), and the basis of the per-rank starvation
+        warning (see the class docstring's balance note)."""
+        g = np.gcd(int(world), self.block)
+        orbit = (int(rank) + int(world) * np.arange(self.block // g)) \
+            % self.block
+        return np.bincount(self.pattern[orbit],
+                           minlength=self.num_sources)
+
+    def check_rank_balance(self, rank: int, world: int,
+                           partition: str) -> None:
+        """Warn loudly when a strided rank's orbit starves a source —
+        the silent skew a docstring alone would not surface."""
+        if partition != "strided" or np.gcd(int(world), self.block) == 1:
+            return  # blocked ranks cover whole blocks; coprime = all slots
+        counts = self.rank_slot_counts(rank, world)
+        starved = [s for s in range(self.num_sources) if counts[s] == 0]
+        if starved:
+            import warnings
+
+            warnings.warn(
+                f"mixture rank {rank} of {world}: strided positions visit "
+                f"only {self.block // np.gcd(int(world), self.block)} of "
+                f"{self.block} pattern slots and NEVER draw source(s) "
+                f"{starved} (gcd(world, block)="
+                f"{np.gcd(int(world), self.block)}); choose a block size "
+                "coprime to the world size or partition='blocked'",
+                stacklevel=3,
+            )
+
 
 #: amortized-evaluator guard: combined per-source table elements
 #: (P * (nw + tail)) beyond this fall back to the per-lane general path

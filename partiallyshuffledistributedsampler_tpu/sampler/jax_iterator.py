@@ -462,6 +462,10 @@ class MixtureEpochIterator(DeviceEpochIterator):
             drop_last_batch=drop_last_batch,
             prefetch_next_epoch=prefetch_next_epoch, **kwargs,
         )
+        # surface the strided-orbit starvation hazard at construction
+        spec.check_rank_balance(
+            rank, world, self.kwargs.get("partition", "strided")
+        )
 
     def _regen(self, epoch: int) -> jax.Array:
         from ..ops.mixture import mixture_epoch_indices_jax

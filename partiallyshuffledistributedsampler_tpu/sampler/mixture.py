@@ -107,6 +107,9 @@ class PartialShuffleMixtureSampler(ChunkedIterMixin, _TorchSampler):
         self.T, self.num_samples, self.total_size = mixture_epoch_sizes(
             self.spec, self.epoch_samples, self.num_replicas, self.drop_last
         )
+        # surface the strided-orbit starvation hazard at construction
+        self.spec.check_rank_balance(self.rank, self.num_replicas,
+                                     self.partition)
         self.epoch = 0
         self._offset = 0
         self._consumed = 0

@@ -587,6 +587,31 @@ def test_sampler_validation_errors():
         make_sampler(epoch_samples=0)
 
 
+def test_strided_orbit_starvation_warns():
+    """gcd(world, block) collapsing a rank's pattern orbit to slots that
+    never draw a source must WARN at construction (exact per-rank check),
+    and stay silent for coprime worlds or blocked partition."""
+    import warnings
+
+    spec = M.MixtureSpec([2000, 100], [199, 1], block=200)
+    # world 100 -> orbit size 2; find a rank whose 2 slots are all source 0
+    starved_rank = next(
+        r for r in range(100)
+        if spec.rank_slot_counts(r, 100)[1] == 0
+    )
+    with pytest.warns(UserWarning, match="NEVER draw"):
+        PartialShuffleMixtureSampler(
+            [2000, 100], [199, 1], block=200,
+            num_replicas=100, rank=starved_rank)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        PartialShuffleMixtureSampler(  # blocked: whole-block coverage
+            [2000, 100], [199, 1], block=200,
+            num_replicas=100, rank=starved_rank, partition="blocked")
+        PartialShuffleMixtureSampler(  # coprime world: all slots visited
+            [2000, 100], [199, 1], block=200, num_replicas=7, rank=0)
+
+
 def test_sampler_accepts_sized_datasets():
     class Sized:
         def __init__(self, n):
