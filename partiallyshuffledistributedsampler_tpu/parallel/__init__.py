@@ -12,5 +12,6 @@ from .sharded import (  # noqa: F401
     make_seed_triple,
     sharded_elastic_indices,
     sharded_epoch_indices,
+    sharded_mixture_elastic_indices,
     sharded_mixture_indices,
 )

@@ -321,6 +321,104 @@ def sharded_mixture_indices(
     return fn(triple_arr)
 
 
+@functools.lru_cache(maxsize=None)
+def _compiled_sharded_mixture_elastic(
+    mesh: Mesh,
+    axis: str,
+    spec_key: tuple,
+    layers_key: tuple,
+    world: int,
+    epoch_samples,
+    shuffle: bool,
+    drop_last: bool,
+    order_windows: bool,
+    partition: str,
+    rounds: int,
+):
+    from ..ops.mixture import (
+        MixtureSpec, _require_x64_for_big_mixture,
+        mixture_elastic_indices_generic,
+    )
+
+    sources, weights, windows, block = spec_key
+    spec = MixtureSpec(sources, weights, windows=list(windows), block=block)
+    T = spec.total_sources_len if epoch_samples is None else int(epoch_samples)
+    chain, _rem, _ns = core.elastic_chain(
+        T, list(layers_key), world, drop_last
+    )
+    _require_x64_for_big_mixture(spec, chain[0][1] * chain[0][0])
+
+    def per_device(local_triple):
+        rank = jax.lax.axis_index(axis)
+        mine = local_triple[0]
+        masked = jnp.where(rank == 0, mine, jnp.zeros_like(mine))
+        agreed = jax.lax.psum(masked, axis)
+        out = mixture_elastic_indices_generic(
+            jnp, spec, (agreed[0], agreed[1]), agreed[2],
+            rank.astype(jnp.uint32), world, list(layers_key),
+            epoch_samples=epoch_samples, shuffle=shuffle,
+            drop_last=drop_last, order_windows=order_windows,
+            partition=partition, rounds=rounds,
+        )
+        return out[None, :]
+
+    from jax import shard_map
+
+    fn = shard_map(
+        per_device,
+        mesh=mesh,
+        in_specs=(P(axis, None),),
+        out_specs=P(axis, None),
+    )
+    in_sharding = NamedSharding(mesh, P(axis, None))
+    return jax.jit(fn, in_shardings=(in_sharding,))
+
+
+def sharded_mixture_elastic_indices(
+    mesh: Mesh,
+    spec,
+    seed,
+    epoch,
+    layers,
+    *,
+    axis: str = "data",
+    epoch_samples=None,
+    shuffle: bool = True,
+    drop_last: bool = False,
+    order_windows: bool = True,
+    partition: str = "strided",
+    rounds: int = core.DEFAULT_ROUNDS,
+    local_seeds=None,
+) -> jax.Array:
+    """All new ranks' remainder-epoch mixture ids as one mesh-sharded
+    array ``[world, num_samples]`` (SPEC.md §6 over §8; empty second axis
+    when nothing remains) — the mixture counterpart of
+    :func:`sharded_elastic_indices`, with the same in-program ICI seed
+    agreement.  Row ``r`` equals
+    ``mixture_elastic_indices_np(spec, seed, epoch, r, world, layers)``
+    bit-exactly."""
+    world = mesh.shape[axis]
+    T = spec.total_sources_len if epoch_samples is None else int(epoch_samples)
+    _chain, remaining, num_samples = core.elastic_chain(
+        T, layers, int(world), bool(drop_last)
+    )
+    if num_samples == 0:
+        dtype = (jnp.int32 if spec.total_sources_len <= 0x7FFFFFFF
+                 else jnp.int64)
+        sharding = NamedSharding(mesh, P(axis, None))
+        return jax.device_put(jnp.empty((world, 0), dtype=dtype), sharding)
+    fn = _compiled_sharded_mixture_elastic(
+        mesh, axis, spec.key(),
+        tuple((int(w), int(c)) for w, c in layers), int(world),
+        None if epoch_samples is None else int(epoch_samples),
+        bool(shuffle), bool(drop_last), bool(order_windows),
+        str(partition), int(rounds),
+    )
+    triple_arr = make_seed_triple(mesh, seed, epoch, axis=axis,
+                                  local_seeds=local_seeds)
+    return fn(triple_arr)
+
+
 def make_seed_triple(mesh: Mesh, seed, epoch, *, axis: str = "data",
                      local_seeds=None) -> jax.Array:
     """The mesh-sharded uint32[world, 3] (seed_lo, seed_hi, epoch) input

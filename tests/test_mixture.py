@@ -288,6 +288,33 @@ def test_sharded_mixture_seed_agreement_rank0_wins():
     assert np.array_equal(out, ref)
 
 
+def test_sharded_mixture_elastic_matches_numpy_per_rank():
+    from partiallyshuffledistributedsampler_tpu.parallel import (
+        data_mesh, sharded_mixture_elastic_indices,
+    )
+
+    spec = make_spec()
+    mesh = data_mesh()
+    world = mesh.shape["data"]
+    layers = [(3, 400)]
+    # divergent non-rank-0 triples: the in-program agreement must win
+    local = np.asarray(
+        [[7, 0, 2]] + [[123 + r, r, 77] for r in range(1, world)],
+        dtype=np.uint32,
+    )
+    out = np.asarray(sharded_mixture_elastic_indices(
+        mesh, spec, None, None, layers, local_seeds=local))
+    assert out.shape[0] == world and out.shape[1] > 0
+    for r in range(world):
+        ref = M.mixture_elastic_indices_np(spec, 7, 2, r, world, layers)
+        assert np.array_equal(out[r], ref), f"rank {r}"
+    # nothing-remaining edge: empty second axis, correct dtype
+    ns = -(-spec.total_sources_len // 2)
+    empty = np.asarray(sharded_mixture_elastic_indices(
+        mesh, spec, 7, 2, [(2, ns)]))
+    assert empty.shape == (world, 0)
+
+
 def test_wide_seed_half_decomposition():
     """§8.3's unbounded-int XOR == the folded-half XOR the mesh program
     uses on the traced triple (the property that makes the ICI path
