@@ -381,3 +381,37 @@ def test_mixture_determinism_and_block_proportions(cfg):
         c = np.bincount(src[blk * B:(blk + 1) * B],
                         minlength=spec.num_sources)
         assert tuple(c) == spec.quotas
+
+
+@settings(max_examples=30, **SETTINGS)
+@given(cfg=MIX_CONFIGS, frac=st.floats(0.05, 0.95),
+       new_world=st.integers(1, 5))
+def test_mixture_elastic_reshard_law(cfg, frac, new_world):
+    """Randomized §6-over-§8: resharding a mixture mid-epoch serves, on
+    each new rank, exactly the stream values at the composed remainder
+    positions; sizes follow the §6 length law."""
+    from partiallyshuffledistributedsampler_tpu.ops import mixture as M
+
+    spec = _mix_spec(cfg)
+    if spec is None:
+        return
+    V = cfg["world"]
+    T = spec.total_sources_len
+    ns_V = -(-T // V)
+    if ns_V < 2:
+        return  # nothing can be mid-epoch-consumed and still remain
+    consumed = max(1, min(int(frac * ns_V), ns_V - 1))
+    layers = [(V, consumed)]
+    R = (ns_V - consumed) * V
+    ns_new = -(-R // new_world)
+    for r in range(new_world):
+        got = M.mixture_elastic_indices_np(
+            spec, cfg["seed"], cfg["epoch"], r, new_world, layers,
+            partition=cfg["partition"])
+        assert len(got) == ns_new
+        q = core.rank_positions(
+            np, R, r, new_world, ns_new, cfg["partition"], np.uint32)
+        pos = core.remaining_stream_positions(
+            np, q, V, ns_V, consumed, cfg["partition"], np.uint32)
+        ref = M.mixture_stream_at_np(pos, spec, cfg["seed"], cfg["epoch"])
+        assert np.array_equal(got, ref)
