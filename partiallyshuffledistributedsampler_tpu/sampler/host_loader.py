@@ -43,8 +43,10 @@ class HostDataLoader:
         are live at once (the producer holds one more while the queue is
         full).  The default 1 therefore double-buffers.
     index_backend: 'cpu' (numpy regen, default), 'native' (C++ host
-        kernel), or 'xla' (device regen + one host readback per epoch —
-        only worth it when the rank's shard is large; cf. utils/autotune).
+        kernel), 'xla' (device regen + one host readback per epoch —
+        only worth it when the rank's shard is large), or 'auto'
+        (cost-based pick per shard size, utils/autotune — the same rule
+        as the torch shim's ``backend='auto'``).
     drop_last_batch: as in DeviceEpochIterator; False serves the trailing
         partial batch.
     device: target for ``jax.device_put`` (default: default device).
@@ -80,6 +82,14 @@ class HostDataLoader:
             raise ValueError(f"rank must be in [0, {world}), got {rank}")
         if depth < 1:
             raise ValueError(f"depth must be >= 1, got {depth}")
+        self._auto_cost = None
+        if index_backend == "auto":
+            from ..utils.autotune import pick_backend
+
+            num_samples, _ = core.shard_sizes(
+                self.n, world, kwargs.get("drop_last", False)
+            )
+            index_backend, self._auto_cost = pick_backend(num_samples)
         try:
             ensure_index_backend(index_backend)  # incl. native build, eagerly
         except ValueError as exc:

@@ -102,6 +102,13 @@ def test_index_backends_bit_identical(backend):
         assert np.array_equal(np.asarray(b["y"]), sl)
 
 
+def test_index_backend_auto_resolves_and_matches():
+    loader = make(index_backend="auto")
+    assert loader.index_backend in ("cpu", "native", "xla")
+    for b, sl in zip(loader.epoch(2), ref_batches(2)):
+        assert np.array_equal(np.asarray(b["y"]), sl)
+
+
 def test_early_break_retires_prefetch_thread():
     loader = make(depth=2)
     before = {t.name for t in threading.enumerate()}
