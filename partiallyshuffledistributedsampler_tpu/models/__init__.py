@@ -1,6 +1,14 @@
 """Consumer models demonstrating the sampler end-to-end on a device mesh."""
 
 from .gpt import GPTConfig, MiniGPT, forward, init_params  # noqa: F401
+from .vit import (  # noqa: F401
+    MiniViT,
+    ViTConfig,
+    demo_vit_run,
+    init_vit_params,
+    make_vit_train_step,
+    vit_forward,
+)
 from .train import (  # noqa: F401
     create_sharded_state,
     demo_training_run,

@@ -35,7 +35,10 @@ class GPTConfig:
 
 
 class Block(nn.Module):
-    cfg: GPTConfig
+    #: cfg duck-types d_model/n_heads/d_ff/dtype — GPTConfig or ViTConfig
+    cfg: Any
+    #: causal masking for decoders; False = bidirectional (ViT encoder)
+    causal: bool = True
 
     @nn.compact
     def __call__(self, x):
@@ -49,8 +52,9 @@ class Block(nn.Module):
         k = k.reshape(B, T, c.n_heads, hd).transpose(0, 2, 1, 3)
         v = v.reshape(B, T, c.n_heads, hd).transpose(0, 2, 1, 3)
         att = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.sqrt(hd).astype(c.dtype)
-        mask = jnp.tril(jnp.ones((T, T), dtype=bool))
-        att = jnp.where(mask, att, jnp.finfo(c.dtype).min)
+        if self.causal:
+            mask = jnp.tril(jnp.ones((T, T), dtype=bool))
+            att = jnp.where(mask, att, jnp.finfo(c.dtype).min)
         att = jax.nn.softmax(att.astype(jnp.float32), axis=-1).astype(c.dtype)
         out = jnp.einsum("bhqk,bhkd->bhqd", att, v)
         out = out.transpose(0, 2, 1, 3).reshape(B, T, D)

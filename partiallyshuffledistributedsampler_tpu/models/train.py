@@ -54,7 +54,26 @@ def _spec_for(path: str, shape) -> P:
 def param_shardings(mesh: Mesh, params) -> Any:
     def leaf(path, x):
         keys = "/".join(str(getattr(p, "key", p)) for p in path)
-        return NamedSharding(mesh, _spec_for(keys, x.shape))
+        spec = _spec_for(keys, x.shape)
+        # a dim that doesn't divide its mesh axis (e.g. a 7-class ViT head
+        # under tp=2) replicates instead of failing placement — GSPMD would
+        # reject the uneven shard, and a replicated head is correct.  Warn:
+        # for a LARGE matrix (an odd vocab embedding) the silently-lost tp
+        # memory saving is something the user should hear about
+        for dim, axis in enumerate(spec):
+            if axis is not None and x.shape[dim] % mesh.shape[axis]:
+                import warnings
+
+                warnings.warn(
+                    f"param {keys} dim {dim} (={x.shape[dim]}) does not "
+                    f"divide mesh axis {axis!r} "
+                    f"(={mesh.shape[axis]}); replicating instead of "
+                    "sharding — pad the dimension if the memory matters",
+                    stacklevel=2,
+                )
+                spec = P()
+                break
+        return NamedSharding(mesh, spec)
 
     return jax.tree_util.tree_map_with_path(leaf, params)
 
@@ -88,12 +107,12 @@ def _make_step_math(cfg: GPTConfig, tx, mesh: Mesh, batch_per_dp: int):
         return -ll.mean()
 
     def step_fn(params, opt_state, tokens, epoch_idx, step):
-        # per-step index window for every dp rank: [dp, batch_per_dp]
-        win = jax.lax.dynamic_slice(
-            epoch_idx,
-            (0, step * batch_per_dp),
-            (dp, batch_per_dp),
-        )
+        # per-step index window for every dp rank: [dp, batch_per_dp] —
+        # via the shared slice primitive (sampler.batch_index_window), the
+        # one home of this law for the GPT and ViT steps alike
+        from ..sampler import batch_index_window
+
+        win = batch_index_window(epoch_idx, step, batch_per_dp)
         batch = tokens[win.reshape(-1)]  # [dp*batch_per_dp, seq+1]
         batch = jax.lax.with_sharding_constraint(
             batch, NamedSharding(mesh, P("dp", None))

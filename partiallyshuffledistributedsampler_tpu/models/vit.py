@@ -1,0 +1,162 @@
+"""Second consumer family: a mini-ViT image classifier (driver configs 2/4
+name ResNet-50 and ViT-L/16 as the image consumers the sampler feeds —
+BASELINE.json; the reference itself has no model zoo, SURVEY.md §0.5).
+
+Same end-to-end demonstration shape as the GPT consumer: the epoch index
+tensor lives in HBM (``parallel.sharded_epoch_indices``), per-step batches
+are dynamic-sliced and gathered INSIDE the jitted step, and params shard
+dp×tp over the mesh with the same Megatron-style placements
+(``train.param_shardings`` — the transformer blocks are shared code).
+
+TPU-first choices: patch embedding as a strided conv (one MXU matmul per
+patch grid), bfloat16 activations, bidirectional attention via the shared
+``Block(causal=False)``, static shapes throughout.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .gpt import Block
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 32
+    patch_size: int = 4
+    channels: int = 3
+    num_classes: int = 10
+    d_model: int = 128
+    n_layers: int = 2
+    n_heads: int = 4
+    d_ff: int = 512
+    dtype: Any = jnp.bfloat16
+
+    def __post_init__(self):
+        if self.image_size % self.patch_size:
+            raise ValueError(
+                f"image_size {self.image_size} not divisible by patch_size "
+                f"{self.patch_size} (the VALID-padded patch conv would "
+                "silently drop edge pixels)"
+            )
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+
+class MiniViT(nn.Module):
+    cfg: ViTConfig
+
+    @nn.compact
+    def __call__(self, images):  # [B, H, W, C]
+        c = self.cfg
+        p = c.patch_size
+        x = nn.Conv(c.d_model, (p, p), strides=(p, p), padding="VALID",
+                    dtype=c.dtype, name="patch")(images.astype(c.dtype))
+        B, h, w, D = x.shape
+        x = x.reshape(B, h * w, D)
+        cls = self.param("cls", nn.initializers.zeros, (1, 1, c.d_model))
+        x = jnp.concatenate(
+            [jnp.broadcast_to(cls, (B, 1, D)).astype(c.dtype), x], axis=1
+        )
+        pos = nn.Embed(h * w + 1, c.d_model, dtype=c.dtype, name="wpe")(
+            jnp.arange(h * w + 1)
+        )
+        x = x + pos[None]
+        for i in range(c.n_layers):
+            x = Block(c, causal=False, name=f"block{i}")(x)
+        x = nn.LayerNorm(dtype=c.dtype, name="lnf")(x)
+        return nn.Dense(c.num_classes, dtype=jnp.float32, name="head")(
+            x[:, 0]  # cls token
+        )
+
+
+def init_vit_params(cfg: ViTConfig, key) -> Any:
+    imgs = jnp.zeros((1, cfg.image_size, cfg.image_size, cfg.channels),
+                     jnp.float32)
+    return MiniViT(cfg).init(key, imgs)["params"]
+
+
+def vit_forward(cfg: ViTConfig, params, images) -> jax.Array:
+    return MiniViT(cfg).apply({"params": params}, images)
+
+
+def make_vit_train_step(cfg: ViTConfig, tx, mesh, batch_per_dp: int):
+    """Jitted step: ``(params, opt_state, images, labels, epoch_idx, step)
+    -> (params, opt_state, loss)`` — epoch_idx is the mesh-sharded
+    [dp, num_samples] tensor from ``sharded_epoch_indices``; the batch
+    gather happens on device exactly as in the GPT consumer."""
+    dp = mesh.shape["dp"]
+
+    def loss_fn(params, imgs, labels):
+        logits = vit_forward(cfg, params, imgs)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.take_along_axis(logp, labels[:, None], axis=-1).mean()
+
+    def step_fn(params, opt_state, images, labels, epoch_idx, step):
+        # the shared per-step window primitive (sampler/jax_iterator) —
+        # one home for the [dp, batch] slice law, as in the GPT step
+        from ..sampler import batch_index_window
+
+        win = batch_index_window(epoch_idx, step, batch_per_dp)
+        flat = win.reshape(-1)
+        imgs = jax.lax.with_sharding_constraint(
+            images[flat], NamedSharding(mesh, P("dp", None, None, None))
+        )
+        labs = jax.lax.with_sharding_constraint(
+            labels[flat], NamedSharding(mesh, P("dp"))
+        )
+        loss, grads = jax.value_and_grad(loss_fn)(params, imgs, labs)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return jax.jit(step_fn, donate_argnums=(0, 1))
+
+
+def demo_vit_run(mesh, cfg: ViTConfig, *, n_samples=256, window=32,
+                 batch_per_dp=4, steps_per_epoch=2, epochs=2, seed=0):
+    """Synthetic end-to-end run: sharded sampler → sharded ViT train step.
+    Returns per-step losses (floats)."""
+    from ..parallel import sharded_epoch_indices
+    from .train import param_shardings
+
+    params = init_vit_params(cfg, jax.random.PRNGKey(seed))
+    params = jax.device_put(params, param_shardings(mesh, params))
+    tx = optax.adamw(3e-4)
+    opt_state = tx.init(params)
+    rng = np.random.default_rng(seed)
+    images = jnp.asarray(rng.normal(size=(
+        n_samples, cfg.image_size, cfg.image_size, cfg.channels
+    )).astype(np.float32))
+    labels = jnp.asarray(rng.integers(0, cfg.num_classes, size=n_samples),
+                         dtype=jnp.int32)
+    step = make_vit_train_step(cfg, tx, mesh, batch_per_dp)
+    dp = mesh.shape["dp"]
+    per_rank = -(-n_samples // dp)
+    if steps_per_epoch * batch_per_dp > per_rank:
+        # dynamic_slice would clamp and silently re-train the trailing
+        # window — the exact failure train.make_run_runner refuses
+        raise ValueError(
+            f"steps_per_epoch={steps_per_epoch} x batch_per_dp="
+            f"{batch_per_dp} exceeds the {per_rank} samples/rank"
+        )
+    losses = []
+    for e in range(epochs):
+        idx = sharded_epoch_indices(mesh, n_samples, window, seed, e,
+                                    axis="dp")
+        for s in range(steps_per_epoch):
+            params, opt_state, loss = step(
+                params, opt_state, images, labels, idx, s
+            )
+            losses.append(float(loss))
+    return losses

@@ -1,0 +1,108 @@
+"""Mini-ViT consumer: the image-side end-to-end demonstration (driver
+configs 2/4 name ResNet/ViT consumers).  Mirrors test_models_train's
+checks: forward shape, mesh-sharded training run with decreasing loss on
+synthetic data, param sharding actually applied, bidirectional attention
+(the shared Block's causal=False path)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from partiallyshuffledistributedsampler_tpu.models import (
+    MiniViT,
+    ViTConfig,
+    demo_vit_run,
+    init_vit_params,
+    make_mesh,
+    vit_forward,
+)
+
+CFG = ViTConfig(image_size=16, patch_size=4, d_model=64, n_layers=1,
+                n_heads=2, d_ff=128, num_classes=7)
+
+
+def test_forward_shape_and_dtype():
+    params = init_vit_params(CFG, jax.random.PRNGKey(0))
+    imgs = jnp.zeros((3, 16, 16, 3), jnp.float32)
+    logits = vit_forward(CFG, params, imgs)
+    assert logits.shape == (3, 7)
+    assert logits.dtype == jnp.float32  # head stays f32 for the softmax
+
+
+def test_attention_is_bidirectional():
+    """causal=False: permuting patch content must affect the cls logits
+    differently than a causal decoder would — concretely, information
+    from the LAST patch must reach the cls token (position 0)."""
+    params = init_vit_params(CFG, jax.random.PRNGKey(1))
+    imgs = np.zeros((1, 16, 16, 3), np.float32)
+    base = np.asarray(vit_forward(CFG, params, jnp.asarray(imgs)))
+    imgs2 = imgs.copy()
+    imgs2[0, 12:, 12:, :] = 5.0  # the last patch only
+    pert = np.asarray(vit_forward(CFG, params, jnp.asarray(imgs2)))
+    assert not np.allclose(base, pert), (
+        "last-patch perturbation did not reach the cls logits — "
+        "attention looks causal"
+    )
+
+
+def test_demo_vit_run_trains_on_mesh():
+    mesh = make_mesh()
+    losses = demo_vit_run(mesh, CFG, n_samples=128, window=16,
+                          batch_per_dp=4, steps_per_epoch=3, epochs=3)
+    assert len(losses) == 9
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0], "loss should decrease on synthetic data"
+
+
+def test_config_and_run_guards():
+    import pytest
+
+    with pytest.raises(ValueError, match="divisible"):
+        ViTConfig(image_size=30, patch_size=4)
+    mesh = make_mesh()
+    with pytest.raises(ValueError, match="samples/rank"):
+        demo_vit_run(mesh, CFG, n_samples=128, batch_per_dp=4,
+                     steps_per_epoch=50)
+
+
+def test_indivisible_sharding_warns():
+    import pytest
+
+    from partiallyshuffledistributedsampler_tpu.models.train import (
+        param_shardings,
+    )
+
+    mesh = make_mesh()
+    params = init_vit_params(CFG, jax.random.PRNGKey(0))  # 7-class head
+    with pytest.warns(UserWarning, match="replicating"):
+        param_shardings(mesh, params)
+
+
+def test_param_shardings_cover_vit_blocks():
+    from partiallyshuffledistributedsampler_tpu.models.train import (
+        param_shardings,
+    )
+
+    mesh = make_mesh()
+    params = init_vit_params(CFG, jax.random.PRNGKey(0))
+    sh = param_shardings(mesh, params)
+    flat = jax.tree_util.tree_flatten_with_path(sh)[0]
+    tp_sharded = [
+        "/".join(str(getattr(p, "key", p)) for p in path)
+        for path, s in flat if "tp" in str(s.spec)
+    ]
+    # the shared transformer block's matmuls shard over tp exactly as in
+    # the GPT consumer (Megatron-style placements are path-keyed)
+    assert any("qkv" in p for p in tp_sharded)
+    assert any("fc1" in p for p in tp_sharded)
+    # the 7-class head does NOT divide tp=2: it must fall back to
+    # replication rather than fail placement
+    assert not any("head" in p for p in tp_sharded)
+    big = ViTConfig(image_size=16, patch_size=4, d_model=64, n_layers=1,
+                    n_heads=2, d_ff=128, num_classes=8)
+    sh2 = param_shardings(mesh, init_vit_params(big, jax.random.PRNGKey(0)))
+    flat2 = jax.tree_util.tree_flatten_with_path(sh2)[0]
+    assert any(
+        "head" in "/".join(str(getattr(p, "key", p)) for p in path)
+        for path, s in flat2 if "tp" in str(s.spec)
+    )  # divisible head shards again
