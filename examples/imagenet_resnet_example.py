@@ -146,9 +146,51 @@ def training_slice_tier() -> None:
           f"{steps} remaining steps exactly; final loss {last.item():.3f}")
 
 
+def jax_native_vit_tier() -> None:
+    """The same image-pipeline shape JAX-native: mesh-sharded sampler →
+    sharded mini-ViT train step, indices never leaving HBM (the ViT-L/16
+    consumer of config 4, pocket-sized)."""
+    import jax
+
+    if jax.device_count() < 2:
+        # the demo wants a mesh; the real-device run of this example has
+        # one chip — the 8-virtual-device path is exercised in CI
+        # (tests/test_models_vit.py) and dryrun_multichip
+        print("tier 3: skipped (single device; see tests/test_models_vit.py)")
+        return
+    from partiallyshuffledistributedsampler_tpu.models import (
+        ViTConfig, demo_vit_run, make_mesh,
+    )
+
+    mesh = make_mesh()
+    losses = demo_vit_run(
+        mesh, ViTConfig(image_size=16, patch_size=4, d_model=64,
+                        n_layers=1, n_heads=2, d_ff=128, num_classes=8),
+        n_samples=256, window=32, batch_per_dp=4, steps_per_epoch=4,
+        epochs=2,
+    )
+    assert losses[-1] < losses[0]
+    print(f"tier 3: JAX-native ViT on {dict(mesh.shape)} mesh — "
+          f"loss {losses[0]:.3f} -> {losses[-1]:.3f}, indices never "
+          "left HBM")
+
+
 def main() -> None:
+    # Demo default: an 8-device virtual CPU mesh, pinned BEFORE the first
+    # backend use (the axon PJRT plugin prepends itself to jax_platforms
+    # even when JAX_PLATFORMS=cpu is exported — cf. jax_training_example).
+    # PSDS_EXAMPLE_REAL=1 uses whatever real devices are present.
+    if os.environ.get("PSDS_EXAMPLE_REAL") != "1":
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8"
+        ).strip()
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
     real_scale_index_tier()
     training_slice_tier()
+    jax_native_vit_tier()
     print("ok: config-2 shape end to end")
 
 

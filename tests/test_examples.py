@@ -30,9 +30,15 @@ def run_example(name: str, extra_env=None, timeout=420) -> str:
 
 
 def test_imagenet_resnet_example():
-    out = run_example("imagenet_resnet_example.py")
+    # force the 8-virtual-device CPU platform so tier 3 (the JAX-native
+    # ViT mesh run) executes rather than skipping on the 1-chip device
+    out = run_example("imagenet_resnet_example.py", {
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+    })
     assert "partition + window locality OK" in out
     assert "resumed 8 remaining steps exactly" in out
+    assert "tier 3: JAX-native ViT" in out
     assert "ok: config-2 shape end to end" in out
 
 
