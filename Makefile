@@ -9,7 +9,7 @@
 #   make native  - build the C++ host backend
 #
 # Gate inventory (all inside `make check`):
-#   * tests/               281+ unit/property/parity tests, forced-CPU
+#   * tests/               450+ unit/property/parity tests, forced-CPU
 #                          8-device platform (tests/conftest.py)
 #   * test_pallas_compiled REAL-device compiled-Mosaic bit-identity gate
 #                          (subprocess, skips loudly off-TPU)
