@@ -14,6 +14,7 @@ from .train import (  # noqa: F401
     demo_training_run,
     make_epoch_runner,
     make_mesh,
+    make_mixture_run_runner,
     make_run_runner,
     make_train_step,
 )

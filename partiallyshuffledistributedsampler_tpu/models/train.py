@@ -206,6 +206,41 @@ def make_run_runner(cfg: GPTConfig, tx, mesh: Mesh, batch_per_dp: int,
     regen_fn, num_samples = make_regen_fn(
         mesh, n_samples, window, axis="dp", **(sampler_kwargs or {})
     )
+    return _run_runner_from_regen(
+        cfg, tx, mesh, batch_per_dp, steps_per_epoch, n_epochs,
+        regen_fn, num_samples,
+    )
+
+
+def make_mixture_run_runner(cfg: GPTConfig, tx, mesh: Mesh, batch_per_dp: int,
+                            steps_per_epoch: int, n_epochs: int, spec, *,
+                            sampler_kwargs: Optional[dict] = None):
+    """The §8 counterpart of :func:`make_run_runner`: a whole multi-epoch
+    MIXTURE pretrain as one jitted program — the mesh-sharded mixture
+    regen (ICI seed agreement + per-source seed derivation + fused §8
+    evaluation, ``parallel.make_mixture_regen_fn``) nests inside the
+    outer epoch scan, and the token gather indexes the CONCATENATED
+    source id space (``tokens`` holds ``spec.total_sources_len`` rows).
+    Same signature and triple plumbing as the single-source runner; the
+    BASELINE config-3 shape (multi-corpus C4 pretrain) runs end-to-end
+    with zero host round-trips.
+    """
+    from ..parallel.sharded import make_mixture_regen_fn
+
+    regen_fn, num_samples = make_mixture_regen_fn(
+        mesh, spec, axis="dp", **(sampler_kwargs or {})
+    )
+    return _run_runner_from_regen(
+        cfg, tx, mesh, batch_per_dp, steps_per_epoch, n_epochs,
+        regen_fn, num_samples,
+    )
+
+
+def _run_runner_from_regen(cfg: GPTConfig, tx, mesh: Mesh, batch_per_dp: int,
+                           steps_per_epoch: int, n_epochs: int,
+                           regen_fn, num_samples: int):
+    """Shared whole-run scan over any ``triple -> [dp, num_samples]``
+    mesh regen program (single-source or mixture)."""
     whole = num_samples // batch_per_dp
     if not 0 < steps_per_epoch <= whole:
         # dynamic_slice would silently CLAMP an oversized start offset and

@@ -10,8 +10,11 @@ checkpoints, and resumes with the same machinery.
 
 Backend-generic like ops.core: every function takes ``xp`` (numpy or
 jax.numpy) and uses exact uint32/uint64 arithmetic, so CPU and XLA are
-bit-identical by construction.  Cost: O(S * len) — one masked §3 pass per
-source (S is small; weights list a handful of corpora).
+bit-identical by construction.  Cost: O(len) — the default fused
+evaluator runs ONE per-lane §3 program with source parameters gathered
+from [S] tables (``_fused_mixture_eval``); the masked per-source loop
+(O(S * len)) remains as the reference evaluator and the fallback for
+>=2^31 sources, bit-identical by test.
 """
 
 from __future__ import annotations
@@ -29,6 +32,8 @@ from . import core
 _MIX_SEED_STRIDE = 0xB5297A4D2C7E9FD3
 #: pass-folding constant (§8.3)
 _C_PASS = 0x632BE5AB
+#: §8.2a (v2) per-block rotation constant
+_C_ROT = 0x6A09E667
 
 DEFAULT_BLOCK = 1024
 
@@ -45,22 +50,31 @@ class MixtureSpec:
     sources: sizes ``n_s`` (>= 1 each).
     weights: integer weights ``v_s`` (>= 1 each; proportions ``v_s/V``).
     windows: per-source window, or one shared int (default
-        ``core.DEFAULT_WINDOW`` capped at each ``n_s``).
+        ``core.DEFAULT_WINDOW`` capped at each ``n_s``); list-form entries
+        are capped at their source size exactly like the shared-int form,
+        so both spellings of a window produce the same stream.
     block:   pattern block size B (§8.1); every aligned B-block realises
         the quotas exactly, so any range of length L is within B of exact
         proportion.
+    pattern_version: 2 (default, §8.2a) rotates the slot pattern per
+        block by a keyed offset when ``shuffle=True``, so EVERY strided
+        rank's orbit sweeps all pattern slots across blocks — the v1
+        starvation hazard (below) cannot occur.  1 reproduces the v1
+        static pattern for checkpoints written by spec-v1 builds.
 
     Raises when a positive-weight source would starve (``k_s == 0``),
     naming a block size sufficient to serve it.
 
-    .. note:: **Per-rank balance under strided partition.**  A strided
-       rank's positions hit pattern slots ``(rank + world*k) mod B``,
-       which is only ``B / gcd(world, B)`` distinct slots — if
-       ``gcd(world, B)`` is large, a rank's *own* source mix can skew
-       arbitrarily (an unlucky rank may never see a small source) even
-       though the global stream is exact.  Pick ``block`` coprime to the
-       world size (or use ``partition='blocked'``, whose contiguous
-       positions cover whole blocks) when per-rank balance matters.
+    .. note:: **Per-rank balance under strided partition (v1 /
+       unshuffled streams).**  With a position-static pattern
+       (``pattern_version=1``, or ``shuffle=False``, where rotation is
+       off so the stream stays a pure deterministic interleave), a
+       strided rank's positions hit pattern slots ``(rank + world*k)
+       mod B`` — only ``B / gcd(world, B)`` distinct slots — so a rank's
+       *own* source mix can skew arbitrarily (an unlucky rank may never
+       see a small source) even though the global stream is exact.  Pick
+       ``block`` coprime to the world size or ``partition='blocked'``
+       there; v2 shuffled streams are immune by construction.
     """
 
     def __init__(
@@ -70,6 +84,7 @@ class MixtureSpec:
         *,
         windows=None,
         block: int = DEFAULT_BLOCK,
+        pattern_version: int = 2,
     ) -> None:
         self.sources = tuple(int(n) for n in sources)
         self.weights = tuple(int(v) for v in weights)
@@ -92,15 +107,27 @@ class MixtureSpec:
         if windows is None:
             windows = core.DEFAULT_WINDOW
         if isinstance(windows, (int, np.integer)):
-            windows = [min(int(windows), n) for n in self.sources]
-        self.windows = tuple(int(w) for w in windows)
-        if len(self.windows) != S:
+            windows = [int(windows)] * S
+        windows = tuple(int(w) for w in windows)
+        if len(windows) != S:
             raise ValueError(
-                f"{S} sources but {len(self.windows)} windows"
+                f"{S} sources but {len(windows)} windows"
             )
-        for s, w in enumerate(self.windows):
+        for s, w in enumerate(windows):
             if w < 1:
                 raise ValueError(f"window for source {s} must be >= 1, got {w}")
+        # cap at each source size for list and int forms alike, so both
+        # spellings of the same window value produce the same stream (an
+        # uncapped oversize entry would route that source through the
+        # pure-tail bijection — valid but different)
+        self.windows = tuple(
+            min(w, n) for w, n in zip(windows, self.sources)
+        )
+        if int(pattern_version) not in (1, 2):
+            raise ValueError(
+                f"pattern_version must be 1 or 2, got {pattern_version}"
+            )
+        self.pattern_version = int(pattern_version)
         self.block = int(block)
         if self.block < S:
             raise ValueError(
@@ -153,7 +180,24 @@ class MixtureSpec:
 
     def key(self) -> tuple:
         """Hashable identity (compiled-program cache key, checkpoint field)."""
-        return (self.sources, self.weights, self.windows, self.block)
+        return (self.sources, self.weights, self.windows, self.block,
+                self.pattern_version)
+
+    @classmethod
+    def from_key(cls, key: tuple) -> "MixtureSpec":
+        """Rebuild a spec from :meth:`key` — the ONE unpack site for every
+        compiled-program cache (a positional unpack in each cache would
+        silently drop fields added to the key)."""
+        sources, weights, windows, block, pattern_version = key
+        return cls(sources, weights, windows=list(windows), block=block,
+                   pattern_version=pattern_version)
+
+    def rotated(self, shuffle: bool) -> bool:
+        """Whether the §8.2a per-block slot rotation applies: v2 specs
+        with ``shuffle=True``.  ``shuffle=False`` keeps rotation off so
+        the unshuffled stream remains a pure deterministic interleave
+        (seed-independent, like the single-source identity stream)."""
+        return bool(shuffle) and self.pattern_version >= 2
 
     def decompose(self, global_ids):
         """Split global ids back into (source_id, local_id) arrays."""
@@ -163,21 +207,27 @@ class MixtureSpec:
         return s.astype(np.int32), gids - bases[s]
 
     def rank_slot_counts(self, rank: int, world: int) -> np.ndarray:
-        """Per-source counts over the pattern slots a STRIDED rank visits
-        (its orbit ``(rank + world*k) mod B``, visited uniformly).  The
-        rank's realized long-run mix is ``counts / counts.sum()`` — exact,
-        cheap (<= B work), and the basis of the per-rank starvation
-        warning (see the class docstring's balance note)."""
+        """Per-source counts over the STATIC pattern slots a strided rank
+        visits (its orbit ``(rank + world*k) mod B``, visited uniformly).
+        The rank's realized long-run mix is ``counts / counts.sum()`` —
+        exact for position-static streams (``pattern_version=1`` or
+        ``shuffle=False``); v2 shuffled streams rotate the pattern per
+        block, so every rank's realized mix is the global mix and this
+        table describes only the un-rotated slots."""
         g = np.gcd(int(world), self.block)
         orbit = (int(rank) + int(world) * np.arange(self.block // g)) \
             % self.block
         return np.bincount(self.pattern[orbit],
                            minlength=self.num_sources)
 
-    def check_rank_balance(self, rank: int, world: int,
-                           partition: str) -> None:
+    def check_rank_balance(self, rank: int, world: int, partition: str,
+                           shuffle: bool = True) -> None:
         """Warn loudly when a strided rank's orbit starves a source —
-        the silent skew a docstring alone would not surface."""
+        the silent skew a docstring alone would not surface.  A no-op for
+        v2 shuffled streams (:meth:`rotated`): the per-block rotation
+        sweeps every orbit across all pattern slots."""
+        if self.rotated(shuffle):
+            return
         if partition != "strided" or np.gcd(int(world), self.block) == 1:
             return  # blocked ranks cover whole blocks; coprime = all slots
         counts = self.rank_slot_counts(rank, world)
@@ -191,7 +241,38 @@ class MixtureSpec:
                 f"{self.block} pattern slots and NEVER draw source(s) "
                 f"{starved} (gcd(world, block)="
                 f"{np.gcd(int(world), self.block)}); choose a block size "
-                "coprime to the world size or partition='blocked'",
+                "coprime to the world size, partition='blocked', or a "
+                "pattern_version=2 shuffled stream (immune by rotation)",
+                stacklevel=3,
+            )
+
+    def check_world_balance(self, world: int, partition: str,
+                            shuffle: bool = True) -> None:
+        """The mesh-path analogue of :meth:`check_rank_balance`: check
+        EVERY rank of a world at once.  Orbits depend on the rank only
+        through ``rank mod gcd(world, B)``, so only ``g`` distinct orbits
+        exist — O(g * B/g) = O(B) total work regardless of world size."""
+        if self.rotated(shuffle):
+            return
+        if partition != "strided" or np.gcd(int(world), self.block) == 1:
+            return
+        g = int(np.gcd(int(world), self.block))
+        bad = []
+        for cls_rank in range(g):
+            counts = self.rank_slot_counts(cls_rank, world)
+            starved = [s for s in range(self.num_sources) if counts[s] == 0]
+            if starved:
+                bad.append((cls_rank, starved))
+        if bad:
+            import warnings
+
+            warnings.warn(
+                f"mixture over world {world}: strided rank classes "
+                f"{[r for r, _ in bad]} (mod gcd(world, block)={g}) NEVER "
+                f"draw source(s) {sorted({s for _, ss in bad for s in ss})}; "
+                "choose a block size coprime to the world size, "
+                "partition='blocked', or a pattern_version=2 shuffled "
+                "stream (immune by rotation)",
                 stacklevel=3,
             )
 
@@ -199,6 +280,147 @@ class MixtureSpec:
 #: amortized-evaluator guard: combined per-source table elements
 #: (P * (nw + tail)) beyond this fall back to the per-lane general path
 _TABLE_CAP = 8_000_000
+
+
+def _swap_or_not_lanes(xp, x, m_lane, msafe_src, key_lane, pair_src,
+                       rounds: int, s_arr):
+    """swap-or-not with a PER-LANE modulus gathered from per-source
+    tables — the engine of the fused mixture evaluation.
+
+    Bit-identical per lane to ``core.swap_or_not(x, m, key, pair_key)``
+    with that lane's ``(m, pair_key)``: the per-round pairing constants
+    ``K_r = mix32(pair_key ^ r*GOLDEN) % m`` depend only on (source,
+    round), so they are computed on the tiny ``[S]`` source vectors and
+    gathered per lane — the per-lane round work stays division-free
+    (add/compare/select + one mix32), exactly like the scalar-m core.
+    Lanes with ``m <= 1`` pass through unchanged (core's early return);
+    ``msafe_src`` is the [S] modulus vector with zeros lifted to 1 so the
+    table computation never divides by zero (those sources own no lanes).
+    """
+    key2 = core.mix32(xp, key_lane ^ core._u32(xp, core._C_BIT))
+    one = core._u32(xp, 1)
+    m_ok = m_lane > one
+    for r in range(rounds):
+        kr_src = core.mix32(
+            xp, pair_src ^ core._u32(xp, (r * core._GOLDEN) & core._M32)
+        ) % msafe_src
+        k_r = xp.take(kr_src, s_arr)
+        partner = k_r + (m_lane - x)
+        partner = xp.where(partner >= m_lane, partner - m_lane, partner)
+        c = xp.where(x > partner, x, partner)
+        b = core.mix32(
+            xp, c ^ key2 ^ core._u32(xp, (r * core._RC_BIT) & core._M32)
+        )
+        x = xp.where(((b & one) == one) & m_ok, partner, x)
+    return x
+
+
+def _fused_mixture_eval(xp, spec: MixtureSpec, s_arr, slot, rot, wrap, blk,
+                        seed, epoch, order_windows: bool, rounds: int,
+                        pos_dtype, out_dtype):
+    """Single-pass evaluation of the §8.3 stream: ONE §3 program over all
+    lanes with per-lane (n, W, nw, tail, keys) gathered from [S] tables,
+    instead of S masked full-lane passes — O(len) total work independent
+    of the source count.  Bit-identical to the masked per-source loop by
+    construction (same bijections, same keys, per-lane instead of
+    per-source evaluation); requires every ``n_s < 2^31`` so the
+    per-source position math fits uint32.
+    """
+    S = spec.num_sources
+    n_np = np.asarray(spec.sources, dtype=np.int64)
+    w_np = np.asarray(spec.windows, dtype=np.int64)
+    nw_np = n_np // w_np          # >= 1: windows are capped at n_s
+    body_np = nw_np * w_np
+    tail_np = n_np - body_np      # in [0, W_s)
+    s_i32 = s_arr.astype(xp.int32)
+
+    def tab_u32(vals):
+        return xp.take(
+            xp.asarray(np.asarray(vals, dtype=np.uint32)), s_i32
+        )
+
+    # ---- per-lane draw ordinal j (the quota law, per-lane) --------------
+    # prefix counts in int32: every count is < B
+    pf32 = xp.asarray(
+        np.ascontiguousarray(spec.prefix.astype(np.int32).reshape(-1))
+    )
+    q32 = xp.asarray(np.asarray(spec.quotas, dtype=np.int32))
+    cnt = xp.take(pf32, slot * S + s_i32)
+    if rot is not None:
+        cnt = (
+            cnt
+            + xp.where(wrap, xp.take(q32, s_i32),
+                       xp.asarray(0, dtype=xp.int32))
+            - xp.take(pf32, rot * S + s_i32)
+        )
+    k_lane = xp.take(
+        xp.asarray(np.asarray(spec.quotas)).astype(pos_dtype), s_i32
+    )
+    n_lane = xp.take(xp.asarray(n_np).astype(pos_dtype), s_i32)
+    j = blk * k_lane + cnt.astype(pos_dtype)
+    pas = (j // n_lane).astype(xp.uint32)
+    u = (j % n_lane).astype(xp.uint32)
+
+    # ---- per-source seeds and pairing keys (§8.3), on [S] vectors -------
+    d = np.asarray(
+        [(_MIX_SEED_STRIDE + s) & 0xFFFFFFFFFFFFFFFF for s in range(S)],
+        dtype=np.uint64,
+    )
+    lo0, hi0 = core.fold_seed(seed)
+    lo_s = core.as_u32_scalar(xp, lo0) ^ xp.asarray(
+        (d & 0xFFFFFFFF).astype(np.uint32))
+    hi_s = core.as_u32_scalar(xp, hi0) ^ xp.asarray(
+        (d >> 32).astype(np.uint32))
+    ep = core.as_u32_scalar(xp, epoch)
+    ek0_src = core.derive_epoch_key(xp, (lo_s, hi_s), ep)  # [S], pass-free
+    # per-lane decision keys: the pass-folded epoch (§8.3) varies per lane
+    ep_u = core.mix32(xp, ep ^ core.mix32(xp, pas ^ core._u32(xp, _C_PASS)))
+    ek_lane = core.derive_epoch_key(
+        xp, (xp.take(lo_s, s_i32), xp.take(hi_s, s_i32)), ep_u
+    )
+
+    # ---- the §3 law, per-lane -------------------------------------------
+    w_u = tab_u32(w_np)
+    nw_u = tab_u32(nw_np)
+    body_u = tab_u32(body_np)
+    nw_safe = np.maximum(nw_np, 1).astype(np.uint32)
+    w_safe = np.maximum(w_np, 1).astype(np.uint32)
+    tail_safe = np.maximum(tail_np, 1).astype(np.uint32)
+    win = u // w_u
+    lim = nw_u - core._u32(xp, 1)
+    win = xp.where(win > lim, lim, win)  # tail lanes clipped, masked below
+    r0 = u % w_u
+    if order_windows:
+        k = _swap_or_not_lanes(
+            xp, win, nw_u, xp.asarray(nw_safe),
+            core.outer_key(xp, ek_lane), core.outer_key(xp, ek0_src),
+            rounds, s_i32,
+        )
+    else:
+        k = win
+    kin = core.inner_key(xp, ek_lane, k)
+    rho = _swap_or_not_lanes(
+        xp, r0, w_u, xp.asarray(w_safe), kin,
+        core.inner_pair_key(xp, ek0_src), rounds, s_i32,
+    )
+    body_idx = k * w_u + rho
+    if (tail_np > 0).any():
+        tail_u = tab_u32(tail_np)
+        tpos = xp.where(u >= body_u, u - body_u, core._u32(xp, 0))
+        tlim = tab_u32(tail_safe) - core._u32(xp, 1)
+        tpos = xp.where(tpos > tlim, tlim, tpos)
+        rho_t = _swap_or_not_lanes(
+            xp, tpos, tail_u, xp.asarray(tail_safe),
+            core.tail_key(xp, ek_lane), core.tail_key(xp, ek0_src),
+            rounds, s_i32,
+        )
+        idx = xp.where(u < body_u, body_idx, body_u + rho_t)
+    else:
+        idx = body_idx
+    base_lane = xp.take(
+        xp.asarray(np.asarray(spec.bases)).astype(out_dtype), s_i32
+    )
+    return base_lane + idx.astype(out_dtype)
 
 
 def _amortized_source_perm(xp, u, pas, n_s, W, seed_pair, ep, P,
@@ -286,6 +508,7 @@ def mixture_stream_at_generic(
     big_positions: Optional[bool] = None,
     amortize: bool = True,
     max_position: Optional[int] = None,
+    fused: Optional[bool] = None,
 ):
     """§8.3: global ids for arbitrary mixture positions (NOT wrapped —
     the mixture stream is total).
@@ -306,6 +529,14 @@ def mixture_stream_at_generic(
     table would exceed the cap, or when the query is too small for table
     construction to pay for itself.  The value is bit-identical either
     way — this is purely an evaluation strategy, tested as such.
+
+    ``fused`` selects the single-pass per-lane evaluator
+    (:func:`_fused_mixture_eval`): one §3 program over ALL lanes with
+    per-lane source parameters instead of S masked per-source passes —
+    O(len) work independent of the source count, the default whenever it
+    applies (``shuffle=True`` and every source < 2^31).  ``False`` forces
+    the masked per-source loop (whose strategy ``amortize`` then
+    selects); values are bit-identical across all three evaluators.
     """
     concrete = None
     if big_positions is None or (amortize and max_position is None):
@@ -333,18 +564,72 @@ def mixture_stream_at_generic(
     )
     p = xp.asarray(positions).astype(pos_dtype)
     B = xp.asarray(spec.block, dtype=pos_dtype)
-    t = (p % B).astype(xp.int32)  # pattern/prefix gather index
+    t = (p % B).astype(xp.int32)  # slot within the block
     blk = p // B
     pattern = xp.asarray(np.asarray(spec.pattern))
-    s_arr = xp.take(pattern, t)
+    B_i32 = xp.asarray(spec.block, dtype=xp.int32)
+    if spec.rotated(shuffle):
+        # §8.2a (v2): rotate the slot pattern per block by a keyed offset,
+        # so a strided rank's orbit sweeps every pattern slot across
+        # blocks (the v1 starvation hazard).  Slot t of block blk draws
+        # pattern[(t + r) mod B]; quotas per block are preserved (a
+        # rotation permutes slots within the block), and the per-source
+        # prefix count becomes a circular-range count over [r, r+t),
+        # evaluated from the same static prefix table with two gathers.
+        lo0, hi0 = core.fold_seed(seed)
+        ek_mix = core.derive_epoch_key(
+            xp,
+            (core.as_u32_scalar(xp, lo0), core.as_u32_scalar(xp, hi0)),
+            epoch,
+        )
+        rk = core.mix32(xp, ek_mix ^ core._u32(xp, _C_ROT))
+        blk_u = blk.astype(xp.uint32)  # rotation keys on blk mod 2^32
+        rot = (core.mix32(xp, rk ^ blk_u)
+               % core._u32(xp, spec.block)).astype(xp.int32)
+        a = t + rot  # in [0, 2B-2]
+        wrap = a >= B_i32
+        slot = xp.where(wrap, a - B_i32, a)
+    else:
+        rot = None
+        wrap = None
+        slot = t
+    s_arr = xp.take(pattern, slot)
+    fused_ok = shuffle and max(spec.sources) <= 0x7FFFFFFF
+    if fused is None:
+        use_fused = fused_ok
+    else:
+        use_fused = bool(fused)
+        if use_fused and not fused_ok:
+            raise ValueError(
+                "fused evaluation requires shuffle=True and every source "
+                "size < 2^31; pass fused=False (or None) here"
+            )
+    if use_fused:
+        return _fused_mixture_eval(
+            xp, spec, s_arr, slot, rot, wrap, blk, seed, epoch,
+            order_windows, rounds, pos_dtype, out_dtype,
+        )
     out = xp.zeros(p.shape, dtype=out_dtype)
     for s in range(spec.num_sources):
         n_s = spec.sources[s]
         k_s = spec.quotas[s]
         W_s = spec.windows[s]
         c_s = xp.asarray(np.ascontiguousarray(spec.prefix[:, s]))
+        if rot is None:
+            cnt = xp.take(c_s, slot)
+        else:
+            # draws of s over the circular slot range [rot, rot+t):
+            # C_s(slot) (+ k_s when the range wraps past B) - C_s(rot);
+            # the sum is non-negative by construction, so the unsigned
+            # cast below is exact
+            cnt = (
+                xp.take(c_s, slot)
+                + xp.where(wrap, xp.asarray(k_s, dtype=c_s.dtype),
+                           xp.asarray(0, dtype=c_s.dtype))
+                - xp.take(c_s, rot)
+            )
         j = blk * xp.asarray(k_s, dtype=pos_dtype) \
-            + xp.take(c_s, t).astype(pos_dtype)
+            + cnt.astype(pos_dtype)
         n_sp = xp.asarray(n_s, dtype=pos_dtype)
         pas = (j // n_sp).astype(xp.uint32)
         u = j % n_sp
@@ -451,6 +736,7 @@ def mixture_epoch_indices_generic(
     partition: str = "strided",
     rounds: int = core.DEFAULT_ROUNDS,
     amortize: bool = True,
+    fused: Optional[bool] = None,
 ):
     """Rank's mixture-epoch global ids (§8.4).
 
@@ -476,7 +762,7 @@ def mixture_epoch_indices_generic(
         xp, p, spec, seed, epoch,
         shuffle=shuffle, order_windows=order_windows, rounds=rounds,
         big_positions=(pos_dtype == xp.uint64),
-        amortize=amortize, max_position=total - 1,
+        amortize=amortize, max_position=total - 1, fused=fused,
     )
 
 
@@ -496,6 +782,7 @@ def mixture_elastic_indices_generic(
     partition: str = "strided",
     rounds: int = core.DEFAULT_ROUNDS,
     amortize: bool = True,
+    fused: Optional[bool] = None,
 ):
     """Elastic remainder-epoch mixture stream (SPEC.md §6 over the §8
     stream).  The §6 law is stream-agnostic — it maps remainder ordinals
@@ -526,7 +813,7 @@ def mixture_elastic_indices_generic(
         xp, pos, spec, seed, epoch,
         shuffle=shuffle, order_windows=order_windows, rounds=rounds,
         big_positions=(pos_dtype == xp.uint64),
-        amortize=amortize, max_position=base_total - 1,
+        amortize=amortize, max_position=base_total - 1, fused=fused,
     )
 
 
@@ -558,6 +845,7 @@ def mixture_elastic_indices_jax(spec, seed, epoch, rank, world, layers,
         kw.pop("order_windows", True), kw.pop("partition", "strided"),
         kw.pop("rounds", core.DEFAULT_ROUNDS),
         kw.pop("amortize", True),
+        kw.pop("fused", None),
     )
     if kw:
         raise TypeError(f"unexpected kwargs: {sorted(kw)}")
@@ -578,12 +866,11 @@ def mixture_elastic_indices_jax(spec, seed, epoch, rank, world, layers,
 @functools.lru_cache(maxsize=64)
 def _compiled_mixture_elastic(spec_key, world, layers_key, epoch_samples,
                               shuffle, drop_last, order_windows, partition,
-                              rounds, amortize):
+                              rounds, amortize, fused=None):
     import jax
     import jax.numpy as jnp
 
-    sources, weights, windows, block = spec_key
-    spec = MixtureSpec(sources, weights, windows=list(windows), block=block)
+    spec = MixtureSpec.from_key(spec_key)
 
     @functools.lru_cache(maxsize=8)
     def for_seed(seed: int):
@@ -594,6 +881,7 @@ def _compiled_mixture_elastic(spec_key, world, layers_key, epoch_samples,
                 epoch_samples=epoch_samples, shuffle=shuffle,
                 drop_last=drop_last, order_windows=order_windows,
                 partition=partition, rounds=rounds, amortize=amortize,
+                fused=fused,
             )
 
         return fn
@@ -602,6 +890,48 @@ def _compiled_mixture_elastic(spec_key, world, layers_key, epoch_samples,
 
 
 # ---------------------------------------------------------------- frontends
+
+def build_mixture_evaluator(
+    spec: MixtureSpec,
+    world: int,
+    *,
+    epoch_samples: Optional[int] = None,
+    shuffle: bool = True,
+    drop_last: bool = False,
+    order_windows: bool = True,
+    partition: str = "strided",
+    rounds: int = core.DEFAULT_ROUNDS,
+    amortize: bool = True,
+    fused: Optional[bool] = None,
+):
+    """The pure-jnp mixture evaluator ``fn(sv) -> ids[num_samples]`` for a
+    static config, with ``sv = uint32[4] (seed_lo, seed_hi, epoch, rank)``
+    traced — the §8 counterpart of ``ops.xla.build_evaluator``, and the
+    piece that lets mixture regen move INSIDE larger jitted programs:
+    ``MixtureEpochIterator.run_epochs`` scans it per epoch, and the mesh
+    run-runner (models/train.make_mixture_run_runner) nests it behind the
+    ICI seed-agreement collective.  Jit-compatible, composable under
+    ``shard_map``/``vmap``; bit-identical to ``mixture_epoch_indices_np``
+    for the same arguments.
+    """
+    import jax.numpy as jnp
+
+    _t, _ns, total = mixture_epoch_sizes(
+        spec, epoch_samples, int(world), bool(drop_last)
+    )
+    _require_x64_for_big_mixture(spec, total)
+
+    def fn(sv):
+        return mixture_epoch_indices_generic(
+            jnp, spec, (sv[0], sv[1]), sv[2], sv[3], int(world),
+            epoch_samples=epoch_samples, shuffle=shuffle,
+            drop_last=drop_last, order_windows=order_windows,
+            partition=partition, rounds=rounds, amortize=amortize,
+            fused=fused,
+        )
+
+    return fn
+
 
 def mixture_epoch_indices_np(spec, seed, epoch, rank, world, **kw):
     """numpy reference frontend."""
@@ -650,6 +980,7 @@ def mixture_epoch_indices_jax(spec, seed, epoch, rank, world, **kw):
         kw.pop("order_windows", True), kw.pop("partition", "strided"),
         kw.pop("rounds", core.DEFAULT_ROUNDS),
         kw.pop("amortize", True),
+        kw.pop("fused", None),
     )
     if kw:
         raise TypeError(f"unexpected kwargs: {sorted(kw)}")
@@ -670,12 +1001,11 @@ def mixture_epoch_indices_jax(spec, seed, epoch, rank, world, **kw):
 @functools.lru_cache(maxsize=64)
 def _compiled_mixture(spec_key, world, epoch_samples, shuffle,
                       drop_last, order_windows, partition, rounds,
-                      amortize=True):
+                      amortize=True, fused=None):
     import jax
     import jax.numpy as jnp
 
-    sources, weights, windows, block = spec_key
-    spec = MixtureSpec(sources, weights, windows=list(windows), block=block)
+    spec = MixtureSpec.from_key(spec_key)
 
     # one executable per concrete seed (the cache comment in
     # mixture_epoch_indices_jax explains the choice); epoch/rank traced
@@ -688,6 +1018,7 @@ def _compiled_mixture(spec_key, world, epoch_samples, shuffle,
                 epoch_samples=epoch_samples, shuffle=shuffle,
                 drop_last=drop_last, order_windows=order_windows,
                 partition=partition, rounds=rounds, amortize=amortize,
+                fused=fused,
             )
 
         return fn

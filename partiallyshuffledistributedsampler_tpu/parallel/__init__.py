@@ -8,6 +8,7 @@ from .mesh import (  # noqa: F401
 )
 from .sharded import (  # noqa: F401
     make_elastic_regen_fn,
+    make_mixture_regen_fn,
     make_regen_fn,
     make_seed_triple,
     sharded_elastic_indices,

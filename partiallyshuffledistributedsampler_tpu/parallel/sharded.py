@@ -257,8 +257,7 @@ def _compiled_sharded_mixture(
         mixture_epoch_indices_generic, mixture_epoch_sizes,
     )
 
-    sources, weights, windows, block = spec_key
-    spec = MixtureSpec(sources, weights, windows=list(windows), block=block)
+    spec = MixtureSpec.from_key(spec_key)
     _t, _ns, total = mixture_epoch_sizes(spec, epoch_samples, world,
                                          drop_last)
     _require_x64_for_big_mixture(spec, total)
@@ -289,6 +288,41 @@ def _compiled_sharded_mixture(
     return jax.jit(fn, in_shardings=(in_sharding,))
 
 
+def make_mixture_regen_fn(
+    mesh: Mesh,
+    spec,
+    *,
+    axis: str = "data",
+    epoch_samples=None,
+    shuffle: bool = True,
+    drop_last: bool = False,
+    order_windows: bool = True,
+    partition: str = "strided",
+    rounds: int = core.DEFAULT_ROUNDS,
+):
+    """Public access to the compiled mesh-sharded MIXTURE regen program:
+    ``(fn, num_samples)`` with ``fn(triple) -> ids[world, num_samples]``
+    — the §8 counterpart of :func:`make_regen_fn`, composable into larger
+    jitted programs the same way (models/train.make_mixture_run_runner
+    scans it inside a whole-run program)."""
+    from ..ops.mixture import mixture_epoch_sizes
+
+    world = mesh.shape[axis]
+    # the mesh builds the same strided per-rank streams as the iterator /
+    # torch sampler — surface the v1 orbit-starvation hazard here too
+    spec.check_world_balance(int(world), str(partition), bool(shuffle))
+    _t, num_samples, _total = mixture_epoch_sizes(
+        spec, epoch_samples, int(world), bool(drop_last)
+    )
+    fn = _compiled_sharded_mixture(
+        mesh, axis, spec.key(), int(world),
+        None if epoch_samples is None else int(epoch_samples),
+        bool(shuffle), bool(drop_last), bool(order_windows),
+        str(partition), int(rounds),
+    )
+    return fn, num_samples
+
+
 def sharded_mixture_indices(
     mesh: Mesh,
     spec,
@@ -309,12 +343,10 @@ def sharded_mixture_indices(
     ``r`` and equals ``mixture_epoch_indices_np(spec, seed, epoch, r,
     world)`` bit-exactly; the epoch seed is agreed over ICI inside the
     same program, exactly like :func:`sharded_epoch_indices`."""
-    world = mesh.shape[axis]
-    fn = _compiled_sharded_mixture(
-        mesh, axis, spec.key(), int(world),
-        None if epoch_samples is None else int(epoch_samples),
-        bool(shuffle), bool(drop_last), bool(order_windows),
-        str(partition), int(rounds),
+    fn, _num = make_mixture_regen_fn(
+        mesh, spec, axis=axis, epoch_samples=epoch_samples, shuffle=shuffle,
+        drop_last=drop_last, order_windows=order_windows,
+        partition=partition, rounds=rounds,
     )
     triple_arr = make_seed_triple(mesh, seed, epoch, axis=axis,
                                   local_seeds=local_seeds)
@@ -340,8 +372,7 @@ def _compiled_sharded_mixture_elastic(
         mixture_elastic_indices_generic,
     )
 
-    sources, weights, windows, block = spec_key
-    spec = MixtureSpec(sources, weights, windows=list(windows), block=block)
+    spec = MixtureSpec.from_key(spec_key)
     T = spec.total_sources_len if epoch_samples is None else int(epoch_samples)
     chain, _rem, _ns = core.elastic_chain(
         T, list(layers_key), world, drop_last
@@ -398,6 +429,7 @@ def sharded_mixture_elastic_indices(
     ``mixture_elastic_indices_np(spec, seed, epoch, r, world, layers)``
     bit-exactly."""
     world = mesh.shape[axis]
+    spec.check_world_balance(int(world), str(partition), bool(shuffle))
     T = spec.total_sources_len if epoch_samples is None else int(epoch_samples)
     _chain, remaining, num_samples = core.elastic_chain(
         T, layers, int(world), bool(drop_last)

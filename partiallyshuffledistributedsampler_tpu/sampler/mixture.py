@@ -32,6 +32,7 @@ from ..ops.mixture import (
 from ._chunked_iter import ChunkedIterMixin
 from .torch_shim import (
     SPEC_VERSION,
+    _check_spec_version,
     _elastic_layers_from_state,
     _resolve_identity,
     _TorchSampler,
@@ -75,12 +76,14 @@ class PartialShuffleMixtureSampler(ChunkedIterMixin, _TorchSampler):
         partition: str = "strided",
         backend: str = "cpu",
         rounds: int = core.DEFAULT_ROUNDS,
+        pattern_version: int = 2,
     ) -> None:
         sizes = [
             int(s) if isinstance(s, (int, np.integer)) else len(s)
             for s in sources
         ]
-        self.spec = MixtureSpec(sizes, weights, windows=windows, block=block)
+        self.spec = MixtureSpec(sizes, weights, windows=windows, block=block,
+                                pattern_version=pattern_version)
         self.num_replicas, self.rank = _resolve_identity(num_replicas, rank)
         if not (0 <= self.rank < self.num_replicas):
             raise ValueError(
@@ -108,8 +111,9 @@ class PartialShuffleMixtureSampler(ChunkedIterMixin, _TorchSampler):
             self.spec, self.epoch_samples, self.num_replicas, self.drop_last
         )
         # surface the strided-orbit starvation hazard at construction
+        # (v1 / unshuffled streams only; v2 rotation is immune)
         self.spec.check_rank_balance(self.rank, self.num_replicas,
-                                     self.partition)
+                                     self.partition, self.shuffle)
         self.epoch = 0
         self._offset = 0
         self._consumed = 0
@@ -223,11 +227,7 @@ class PartialShuffleMixtureSampler(ChunkedIterMixin, _TorchSampler):
                 f"checkpoint kind {state.get('kind')!r} is not a mixture "
                 "checkpoint"
             )
-        if state.get("spec_version", SPEC_VERSION) != SPEC_VERSION:
-            raise ValueError(
-                f"checkpoint from spec version {state['spec_version']}, "
-                f"this build implements {SPEC_VERSION}"
-            )
+        _check_spec_version(state)
         for f in ("sources", "weights", "num_replicas", "offset", "seed",
                   "epoch"):
             if f not in state:
@@ -245,8 +245,24 @@ class PartialShuffleMixtureSampler(ChunkedIterMixin, _TorchSampler):
             order_windows=state.get("order_windows", True),
             partition=state.get("partition", "strided"),
             rounds=int(state.get("rounds", core.DEFAULT_ROUNDS)),
+            # absent in v1-build checkpoints, whose streams are the static
+            # pattern — resharding must reproduce exactly that stream
+            pattern_version=int(state.get("pattern_version", 1)),
             **kwargs,
         )
+        if "windows" in state and list(state["windows"]) != list(
+            sampler.spec.windows
+        ):
+            # a v1 build stored LIST-form windows uncapped; an oversized
+            # entry routed that source through the pure-tail bijection — a
+            # stream this build no longer implements (windows are capped
+            # at each n_s).  Resharding would silently repeat/skip samples.
+            raise ValueError(
+                f"checkpoint windows {list(state['windows'])} cannot be "
+                f"reproduced: this build caps windows at each source size "
+                f"(-> {list(sampler.spec.windows)}); the remainder stream "
+                "would not match the consumed prefix"
+            )
         sampler.epoch = int(state["epoch"])
         layers = _elastic_layers_from_state(state.get("elastic")) or []
         layers = layers + [(int(state["num_replicas"]), int(state["offset"]))]
@@ -301,6 +317,7 @@ class PartialShuffleMixtureSampler(ChunkedIterMixin, _TorchSampler):
             "weights": list(self.spec.weights),
             "windows": list(self.spec.windows),
             "block": self.spec.block,
+            "pattern_version": self.spec.pattern_version,
             "seed": self.seed,
             "epoch": self.epoch,
             "offset": int(self._consumed if consumed is None else consumed),
@@ -314,11 +331,7 @@ class PartialShuffleMixtureSampler(ChunkedIterMixin, _TorchSampler):
         return state
 
     def load_state_dict(self, state: dict) -> None:
-        if state.get("spec_version", SPEC_VERSION) != SPEC_VERSION:
-            raise ValueError(
-                f"checkpoint from spec version {state['spec_version']}, "
-                f"this build implements {SPEC_VERSION}"
-            )
+        _check_spec_version(state)
         if state.get("kind") != "mixture":
             # a single-source checkpoint's fields (n/window/...) appear in
             # none of the guards below, so without this check it would load
@@ -342,6 +355,21 @@ class PartialShuffleMixtureSampler(ChunkedIterMixin, _TorchSampler):
                     f"sampler has {f}={mine!r}; the offset would resume into "
                     "a different mixture stream"
                 )
+        # a checkpoint without the field was written by a v1 build — its
+        # stream is the static-pattern law, so missing means 1, and a
+        # skip-if-absent check would silently resume into the wrong stream
+        ckpt_pv = int(state.get("pattern_version", 1))
+        if ckpt_pv != self.spec.pattern_version:
+            raise ValueError(
+                f"checkpoint was written with pattern_version={ckpt_pv} but "
+                f"this sampler has {self.spec.pattern_version}; construct "
+                f"the sampler with pattern_version={ckpt_pv} to resume it"
+            )
+        for f in ("seed", "epoch"):
+            # a truncated checkpoint must fail the load_state_dict contract
+            # (ValueError naming the field), not KeyError at the assignment
+            if f not in state:
+                raise ValueError(f"state_dict lacks {f!r}")
         for f in self._CONFIG_FIELDS:
             if f in state and state[f] != getattr(self, f):
                 raise ValueError(

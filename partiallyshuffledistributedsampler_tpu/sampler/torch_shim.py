@@ -39,7 +39,23 @@ except Exception:  # torch is an optional dependency of this framework
 from ..ops import core
 from ._chunked_iter import ChunkedIterMixin
 
-SPEC_VERSION = 1
+#: written into new checkpoints.  v2 changed ONLY the §8 mixture slot
+#: selection (per-block rotation, gated by MixtureSpec.pattern_version);
+#: every §1-§7 stream is bit-identical to v1, so v1 checkpoints stay
+#: loadable — mixture loads additionally reconcile pattern_version.
+SPEC_VERSION = 2
+_ACCEPTED_SPEC_VERSIONS = (1, 2)
+
+
+def _check_spec_version(state: dict) -> None:
+    """Reject checkpoints from spec versions this build cannot reproduce."""
+    v = state.get("spec_version", SPEC_VERSION)
+    if v not in _ACCEPTED_SPEC_VERSIONS:
+        raise ValueError(
+            f"checkpoint from spec version {v}, this build implements "
+            f"{_ACCEPTED_SPEC_VERSIONS}; the permutation law differs and "
+            "silent reshuffling would occur"
+        )
 
 
 def _resolve_identity(num_replicas: Optional[int], rank: Optional[int]):
@@ -376,12 +392,7 @@ class PartiallyShuffleDistributedSampler(ChunkedIterMixin, _TorchSampler):
         an ordinary sampler of the new world size.  Exactly-once coverage
         (consumed prefix + remainder = one full epoch) is the tested law.
         """
-        if state.get("spec_version", SPEC_VERSION) != SPEC_VERSION:
-            raise ValueError(
-                f"checkpoint from spec version {state['spec_version']}, "
-                f"this build implements {SPEC_VERSION}; the permutation law "
-                "differs and silent reshuffling would occur"
-            )
+        _check_spec_version(state)
         required = ("num_replicas", "offset", "n", "seed", "epoch")
         for f in required:
             if f not in state:
@@ -446,12 +457,7 @@ class PartiallyShuffleDistributedSampler(ChunkedIterMixin, _TorchSampler):
         return state
 
     def load_state_dict(self, state: dict) -> None:
-        if state.get("spec_version", SPEC_VERSION) != SPEC_VERSION:
-            raise ValueError(
-                f"checkpoint from spec version {state['spec_version']}, "
-                f"this build implements {SPEC_VERSION}; the permutation law "
-                "differs and silent reshuffling would occur"
-            )
+        _check_spec_version(state)
         # pre-round-4 checkpoints carry no kind field: they are all single
         if state.get("kind", "single") != "single":
             raise ValueError(

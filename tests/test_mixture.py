@@ -355,10 +355,122 @@ def test_mixture_epoch_iterator_serves_the_stream():
                          for b in it.elastic_epoch(3, [(2, 100)])])
     eref = M.mixture_elastic_indices_np(spec, 7, 3, 1, 2, [(2, 100)])
     assert np.array_equal(el, eref[:(len(eref) // 64) * 64])
-    with pytest.raises(NotImplementedError, match="run_epochs"):
-        it.run_epochs(0, 2, step, 0)
     with pytest.raises(TypeError, match="MixtureSpec"):
         MixtureEpochIterator([1000], batch=8)
+
+
+def test_mixture_run_epochs_matches_run_epoch():
+    """The §8 in-program tier (round-5): run_epochs — regen scanned
+    INSIDE one compiled program via build_mixture_evaluator — must be
+    bit-identical to driving the same epochs one run_epoch at a time,
+    over >= 3 epochs, collect on and off."""
+    import jax.numpy as jnp
+
+    from partiallyshuffledistributedsampler_tpu.sampler import (
+        MixtureEpochIterator,
+    )
+
+    spec = make_spec()
+
+    def step(c, b):
+        # value-sensitive fold: any reordering or off-by-one changes it
+        return c * jnp.int32(31) + jnp.sum(b) % jnp.int32(100003)
+
+    it = MixtureEpochIterator(spec, batch=64, seed=7, rank=1, world=2)
+    c_seq = jnp.int32(1)
+    for e in range(2, 5):
+        c_seq = it.run_epoch(e, step, c_seq)
+    it2 = MixtureEpochIterator(spec, batch=64, seed=7, rank=1, world=2)
+    c_one = it2.run_epochs(2, 3, step, jnp.int32(1))
+    assert int(c_seq) == int(c_one)
+
+    def step2(c, b):
+        return c + 1, jnp.sum(b)
+
+    it3 = MixtureEpochIterator(spec, batch=64, seed=7, rank=1, world=2)
+    c, ys = it3.run_epochs(0, 3, step2, jnp.int32(0), collect=True)
+    whole = it3.num_samples // 64
+    assert np.asarray(ys).shape == (3, whole)
+    for e in range(3):
+        ref = M.mixture_epoch_indices_np(spec, 7, e, 1, 2)
+        sums = [int(ref[i * 64:(i + 1) * 64].sum()) for i in range(whole)]
+        assert np.asarray(ys)[e].tolist() == sums
+
+
+def test_build_mixture_evaluator_is_the_stream():
+    """fn(sv) == mixture_epoch_indices_np for the same (seed, epoch,
+    rank), under jit, for plain and elaborate configs."""
+    import jax
+    import jax.numpy as jnp
+
+    spec = make_spec()
+    for kw in ({}, {"partition": "blocked"}, {"epoch_samples": 777},
+               {"order_windows": False}, {"fused": False}):
+        ev = jax.jit(M.build_mixture_evaluator(spec, 4, **kw))
+        npkw = {k: v for k, v in kw.items()}
+        for seed, epoch, rank in [(7, 0, 0), (7, 3, 2), (999, 1, 3)]:
+            lo, hi = seed & 0xFFFFFFFF, (seed >> 32) & 0xFFFFFFFF
+            sv = jnp.asarray([lo, hi, epoch, rank], dtype=jnp.uint32)
+            got = np.asarray(ev(sv))
+            ref = M.mixture_epoch_indices_np(spec, seed, epoch, rank, 4,
+                                             **npkw)
+            assert np.array_equal(got, ref), (kw, seed, epoch, rank)
+
+
+def test_mixture_iterator_windows_property():
+    """Round-4 weak #6: introspecting the per-source windows must return
+    the spec's tuple, and the base class's meaningless scalar sentinel
+    must not be published."""
+    from partiallyshuffledistributedsampler_tpu.sampler import (
+        MixtureEpochIterator,
+    )
+
+    spec = make_spec()
+    it = MixtureEpochIterator(spec, batch=64, seed=7, rank=0, world=2)
+    assert it.windows == spec.windows
+    with pytest.raises(AttributeError, match="windows"):
+        it.window
+
+
+def test_fused_evaluator_bit_identical_to_masked():
+    """The round-5 fused per-lane evaluator (one §3 program over all
+    lanes, [S]-table parameter gathers) vs the masked per-source loop:
+    bit-identical across pattern versions, window shapes, order_windows,
+    and backends — it is an evaluation strategy, never a stream change."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(0)
+    cases = [
+        ([1000, 500, 2500], [5, 1, 4], 64, 100),
+        ([7, 1000, 13], [1, 5, 2], [7, 64, 13], 50),   # W == n sources
+        ([97, 31], [3, 1], 10, 16),                    # tails everywhere
+        ([64, 128], [1, 1], [64, 32], 10),             # no tails
+        ([5, 2000], [1, 9], 1, 100),                   # W=1
+        ([1], [1], 1, 4),                              # single tiny source
+    ]
+    pos = np.concatenate([np.arange(2000),
+                          rng.integers(0, 50_000, 300)])
+    for sizes, weights, windows, block in cases:
+        for pv in (1, 2):
+            spec = M.MixtureSpec(sizes, weights, windows=windows,
+                                 block=block, pattern_version=pv)
+            for ow in (True, False):
+                a = M.mixture_stream_at_generic(
+                    np, pos, spec, 12345678901, 3, order_windows=ow,
+                    fused=False, amortize=False)
+                b = M.mixture_stream_at_generic(
+                    np, pos, spec, 12345678901, 3, order_windows=ow,
+                    fused=True)
+                c = np.asarray(M.mixture_stream_at_generic(
+                    jnp, pos, spec, 12345678901, 3, order_windows=ow,
+                    fused=True))
+                assert np.array_equal(a, b), (sizes, pv, ow)
+                assert np.array_equal(a, c), (sizes, pv, ow, "jax")
+    # fused requires shuffle and int32-range sources — explicit pins fail
+    spec = M.MixtureSpec([100], [1])
+    with pytest.raises(ValueError, match="fused"):
+        M.mixture_stream_at_generic(np, pos, spec, 0, 0, shuffle=False,
+                                    fused=True)
 
 
 # ------------------------------------------------- elastic (§6 over §8)
@@ -482,14 +594,24 @@ def test_mixture_reshard_rejects_single_kind():
 
 # --------------------------------------------------------------- goldens
 def test_golden_mixture_frozen():
-    """Spec §8 freeze: changing quotas, pattern, seed folding, pass
-    folding, or the stream law breaks these constants (version bump +
-    regenerated goldens required, per SPEC.md header)."""
-    spec = make_spec()
-    assert spec.pattern[:10].tolist() == [0, 2, 0, 2, 0, 1, 2, 0, 2, 0]
-    ids = M.mixture_epoch_indices_np(spec, 7, 3, 0, 1)
-    assert ids[:8].tolist() == [394, 2255, 425, 2252, 411, 1363, 2260, 402]
-    assert int(ids.sum()) == 5793243
+    """Spec §8 freeze, BOTH pattern versions: changing quotas, pattern,
+    rotation, seed folding, pass folding, or the stream law breaks these
+    constants (version bump + regenerated goldens required, per SPEC.md
+    header).  The v1 constants are the round-4 goldens, unchanged — v1
+    checkpoint streams must survive the v2 bump bit-for-bit."""
+    spec1 = make_spec(pattern_version=1)
+    assert spec1.pattern[:10].tolist() == [0, 2, 0, 2, 0, 1, 2, 0, 2, 0]
+    ids1 = M.mixture_epoch_indices_np(spec1, 7, 3, 0, 1)
+    assert ids1[:8].tolist() == [394, 2255, 425, 2252, 411, 1363, 2260, 402]
+    assert int(ids1.sum()) == 5793243
+    spec2 = make_spec()  # pattern_version=2 default: §8.2a rotation
+    assert spec2.pattern[:10].tolist() == [0, 2, 0, 2, 0, 1, 2, 0, 2, 0]
+    ids2 = M.mixture_epoch_indices_np(spec2, 7, 3, 0, 1)
+    assert ids2[:8].tolist() == [2255, 394, 2252, 425, 1363, 2260, 411, 2262]
+    # same multiset over a full single-rank epoch (rotation permutes block
+    # slots, it never changes which draws happen), different order
+    assert int(ids2.sum()) == 5793243
+    assert not np.array_equal(ids1, ids2)
 
 
 # ------------------------------------------------------- sampler surface
@@ -616,11 +738,13 @@ def test_sampler_validation_errors():
 
 def test_strided_orbit_starvation_warns():
     """gcd(world, block) collapsing a rank's pattern orbit to slots that
-    never draw a source must WARN at construction (exact per-rank check),
-    and stay silent for coprime worlds or blocked partition."""
+    never draw a source must WARN at construction for the position-static
+    streams it can actually starve (pattern_version=1, or
+    shuffle=False), and stay silent for coprime worlds, blocked
+    partition, or v2 shuffled streams (rotation-immune)."""
     import warnings
 
-    spec = M.MixtureSpec([2000, 100], [199, 1], block=200)
+    spec = M.MixtureSpec([2000, 100], [199, 1], block=200, pattern_version=1)
     # world 100 -> orbit size 2; find a rank whose 2 slots are all source 0
     starved_rank = next(
         r for r in range(100)
@@ -628,15 +752,130 @@ def test_strided_orbit_starvation_warns():
     )
     with pytest.warns(UserWarning, match="NEVER draw"):
         PartialShuffleMixtureSampler(
-            [2000, 100], [199, 1], block=200,
+            [2000, 100], [199, 1], block=200, pattern_version=1,
+            num_replicas=100, rank=starved_rank)
+    with pytest.warns(UserWarning, match="NEVER draw"):
+        # v2 UNSHUFFLED: rotation off, the static orbit genuinely starves
+        PartialShuffleMixtureSampler(
+            [2000, 100], [199, 1], block=200, shuffle=False,
             num_replicas=100, rank=starved_rank)
     with warnings.catch_warnings():
         warnings.simplefilter("error")
-        PartialShuffleMixtureSampler(  # blocked: whole-block coverage
+        PartialShuffleMixtureSampler(  # v2 shuffled: rotation-immune
             [2000, 100], [199, 1], block=200,
+            num_replicas=100, rank=starved_rank)
+        PartialShuffleMixtureSampler(  # blocked: whole-block coverage
+            [2000, 100], [199, 1], block=200, pattern_version=1,
             num_replicas=100, rank=starved_rank, partition="blocked")
         PartialShuffleMixtureSampler(  # coprime world: all slots visited
-            [2000, 100], [199, 1], block=200, num_replicas=7, rank=0)
+            [2000, 100], [199, 1], block=200, pattern_version=1,
+            num_replicas=7, rank=0)
+
+
+def test_v2_rotation_cures_starved_orbit():
+    """§8.2a's done-criterion: a (rank, world, block) whose v1 orbit NEVER
+    draws a source must, under v2, draw every source at close to its
+    global proportion — and the per-block quota exactness must survive
+    the rotation."""
+    spec1 = M.MixtureSpec([2000, 100], [199, 1], block=200,
+                          pattern_version=1)
+    starved_rank = next(
+        r for r in range(100)
+        if spec1.rank_slot_counts(r, 100)[1] == 0
+    )
+    T = 400_000  # 2000 blocks -> expected ~20 draws of the 1/200 source
+    ids1 = M.mixture_epoch_indices_np(
+        spec1, 0, 0, starved_rank, 100, epoch_samples=T)
+    c1 = np.bincount(spec1.decompose(ids1)[0], minlength=2)
+    assert c1[1] == 0  # v1: starved, permanently
+    spec2 = M.MixtureSpec([2000, 100], [199, 1], block=200)
+    ids2 = M.mixture_epoch_indices_np(
+        spec2, 0, 0, starved_rank, 100, epoch_samples=T)
+    c2 = np.bincount(spec2.decompose(ids2)[0], minlength=2)
+    expected = len(ids2) / 200
+    assert 0.3 * expected <= c2[1] <= 3 * expected  # drawn, ~proportional
+    # rotation preserves exact per-block quotas
+    g = M.mixture_stream_at_np(np.arange(10 * 200), spec2, 0, 0)
+    s, _ = spec2.decompose(g)
+    for b in range(10):
+        assert np.bincount(s[b * 200:(b + 1) * 200],
+                           minlength=2).tolist() == list(spec2.quotas)
+
+
+def test_pattern_version_identity_and_validation():
+    """key() carries pattern_version (compiled-program caches must not
+    alias v1/v2); from_key round-trips; invalid versions rejected."""
+    s1 = make_spec(pattern_version=1)
+    s2 = make_spec()
+    assert s1.key() != s2.key()
+    for s in (s1, s2):
+        r = M.MixtureSpec.from_key(s.key())
+        assert r.key() == s.key()
+        assert r.pattern_version == s.pattern_version
+    with pytest.raises(ValueError, match="pattern_version"):
+        make_spec(pattern_version=3)
+    assert s2.rotated(True) and not s2.rotated(False)
+    assert not s1.rotated(True)
+
+
+def test_checkpoint_pattern_version_reconciled():
+    """A v1-build mixture checkpoint (no pattern_version field) must not
+    load into a default (v2) sampler — and must load into a
+    pattern_version=1 sampler; reshard rebuilds at the checkpoint's
+    version."""
+    v1 = make_sampler(pattern_version=1)
+    v1.set_epoch(2)
+    state = v1.state_dict(consumed=50)
+    legacy = dict(state)
+    del legacy["pattern_version"]
+    legacy["spec_version"] = 1
+    modern = make_sampler()
+    with pytest.raises(ValueError, match="pattern_version"):
+        modern.load_state_dict(legacy)
+    full_v1 = make_sampler(pattern_version=1)
+    full_v1.set_epoch(2)
+    full = list(full_v1)
+    fresh = make_sampler(pattern_version=1)
+    fresh.load_state_dict(legacy)
+    assert list(fresh) == full[50:]
+    # reshard from the legacy checkpoint reproduces the v1 stream
+    re = PartialShuffleMixtureSampler.reshard_from_state_dict(
+        legacy, num_replicas=2, rank=0)
+    assert re.spec.pattern_version == 1
+    # a v2 checkpoint loads into a v2 sampler and rejects a v1 one
+    v2 = make_sampler()
+    v2.set_epoch(2)
+    st2 = v2.state_dict(consumed=10)
+    with pytest.raises(ValueError, match="pattern_version"):
+        make_sampler(pattern_version=1).load_state_dict(st2)
+    make_sampler().load_state_dict(st2)
+
+
+def test_mixture_load_missing_fields_raise_valueerror():
+    """A truncated checkpoint fails with the load contract's ValueError
+    naming the field, not a KeyError at the assignment block."""
+    s = make_sampler()
+    s.set_epoch(1)
+    state = s.state_dict()
+    for f in ("seed", "epoch"):
+        broken = dict(state)
+        del broken[f]
+        with pytest.raises(ValueError, match=f):
+            make_sampler().load_state_dict(broken)
+
+
+def test_list_windows_capped_like_int_windows():
+    """An explicit per-source windows list with an oversized entry must
+    produce the same stream as the capped shared-int spelling (ADVICE r4:
+    an uncapped list entry silently routed that source through the pure
+    tail bijection)."""
+    a = M.MixtureSpec([100, 500], [1, 1], windows=[4096, 64])
+    b = M.MixtureSpec([100, 500], [1, 1], windows=[100, 64])
+    assert a.windows == (100, 64)
+    assert a.key() == b.key()
+    ia = M.mixture_epoch_indices_np(a, 3, 1, 0, 1)
+    ib = M.mixture_epoch_indices_np(b, 3, 1, 0, 1)
+    assert np.array_equal(ia, ib)
 
 
 def test_sampler_accepts_sized_datasets():

@@ -85,6 +85,47 @@ def test_one_program_run_matches_step_loop():
     np.testing.assert_allclose(whole, stepped, rtol=1e-5, atol=1e-6)
 
 
+def test_mixture_run_runner_matches_manual_epochs():
+    """make_mixture_run_runner (the §8 whole-run program: mesh-sharded
+    mixture regen scanned in-program) must reproduce the trajectory of
+    manually driving make_epoch_runner over sharded_mixture_indices
+    epoch by epoch — same model, same tokens, same seed."""
+    from partiallyshuffledistributedsampler_tpu.models import (
+        make_epoch_runner, make_mixture_run_runner,
+    )
+    from partiallyshuffledistributedsampler_tpu.models.train import (
+        create_sharded_state,
+    )
+    from partiallyshuffledistributedsampler_tpu.ops.mixture import (
+        MixtureSpec,
+    )
+    from partiallyshuffledistributedsampler_tpu.parallel import (
+        make_seed_triple, sharded_mixture_indices,
+    )
+
+    mesh = make_mesh(8)
+    spec = MixtureSpec([60, 40, 20], [3, 2, 1], windows=8, block=12)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(2), (spec.total_sources_len, TINY.seq_len + 1),
+        0, TINY.vocab_size, dtype=jnp.int32,
+    )
+    params, opt, tx = create_sharded_state(TINY, mesh, seed=3)
+    run = make_mixture_run_runner(TINY, tx, mesh, 2, 2, 2, spec)
+    triple = make_seed_triple(mesh, 5, 0, axis="dp")
+    _p, _o, ls = run(params, opt, tokens, triple, jnp.int32(0))
+    whole = np.asarray(ls).reshape(-1)
+
+    params2, opt2, tx2 = create_sharded_state(TINY, mesh, seed=3)
+    epoch_run = make_epoch_runner(TINY, tx2, mesh, 2, 2)
+    manual = []
+    for e in range(2):
+        idx = sharded_mixture_indices(mesh, spec, 5, e, axis="dp")
+        params2, opt2, el = epoch_run(params2, opt2, tokens, idx)
+        manual.extend(float(l) for l in np.asarray(el))
+    assert len(whole) == len(manual) == 4
+    np.testing.assert_allclose(whole, manual, rtol=1e-5, atol=1e-6)
+
+
 def test_training_deterministic_across_meshes():
     # dp=4,tp=2 vs dp=2,tp=2: same data order per epoch (the sampler contract
     # holds per dp-world); losses differ because dp-world differs — but a

@@ -164,7 +164,7 @@ def test_state_dict_roundtrip_fields():
     st = s.state_dict(consumed=5)
     # dynamic state...
     assert {k: st[k] for k in ("spec_version", "seed", "epoch", "offset")} == {
-        "spec_version": 1, "seed": 0, "epoch": 0, "offset": 5
+        "spec_version": 2, "seed": 0, "epoch": 0, "offset": 5
     }
     # ...plus the permutation config, validated on load (ADVICE round 1)
     for f in PartiallyShuffleDistributedSampler._CONFIG_FIELDS:
