@@ -206,6 +206,39 @@ def main() -> None:
     except Exception as exc:
         details["cpu_error"] = repr(exc)[:200]
 
+    # the §8 mixture tier (round 5): the fused per-lane evaluator at the
+    # 1B 3-corpus anchor, packed-gather regime (worlds 256/32 — world 8
+    # switches gather strategy past _ROT_PACK_LANES_CAP and would mix
+    # cost regimes into the fit; BASELINE.md round-5 records all three),
+    # plus the round-4 masked evaluator at the 256 anchor for the
+    # same-session ratio
+    if not smoke:
+        try:
+            from partiallyshuffledistributedsampler_tpu.ops.mixture import (
+                MixtureSpec, mixture_epoch_indices_jax,
+            )
+
+            parts = [N * 7 // 10, N * 2 // 10,
+                     N - N * 7 // 10 - N * 2 // 10]
+            spec = MixtureSpec(parts, [70, 20, 10], windows=WINDOW)
+            mt = {w: _anchored_ms_per_epoch(
+                lambda e, w=w: mixture_epoch_indices_jax(
+                    spec, SEED, e, 0, w)
+            ) for w in (256, 32)}
+            k_mix = (mt[32] - mt[256]) / (ns[32] - ns[256])
+            details["mixture_fused_wall256_ms"] = round(mt[256], 3)
+            details["mixture_fused_kernel256_ms"] = round(
+                max(k_mix * ns[256], 0.0), 3)
+            masked256 = _anchored_ms_per_epoch(
+                lambda e: mixture_epoch_indices_jax(
+                    spec, SEED, e, 0, 256, fused=False)
+            )
+            details["mixture_masked_wall256_ms"] = round(masked256, 3)
+            details["mixture_fused_speedup_wall256"] = round(
+                masked256 / max(mt[256], 1e-9), 2)
+        except Exception as exc:
+            details["mixture_error"] = repr(exc)[:200]
+
     # interim details to stderr BEFORE the slow stall tier: a driver-side
     # timeout mid-stall then still leaves the evaluator fits on record
     # (the final line below supersedes this one when the run completes;

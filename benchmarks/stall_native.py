@@ -170,6 +170,7 @@ def native_stall(world: int, *, n: int = N_NATIVE, window: int = WINDOW,
     for r in range(1, reps + 1):
         t_s.append(run(True, epoch_base + r * (epochs + 2)))
         t_c.append(run(False, epoch_base))
+    capped_noise_band_s = max(t_c) - min(t_c)  # constant arm's rep spread
     t_s.sort(), t_c.sort()
     ts, tc = t_s[len(t_s) // 2], t_c[len(t_c) // 2]
 
@@ -197,10 +198,16 @@ def native_stall(world: int, *, n: int = N_NATIVE, window: int = WINDOW,
         return time.perf_counter() - t0
 
     run_steady(True), run_steady(False)  # warmup
-    ss = min(run_steady(True) for _ in range(reps))
-    sc = min(run_steady(False) for _ in range(reps))
+    ss_runs = [run_steady(True) for _ in range(reps)]
+    sc_runs = [run_steady(False) for _ in range(reps)]
+    ss, sc = min(ss_runs), min(sc_runs)
     per_step_overhead_ms = max(ss - sc, 0.0) * 1e3 / n_steady
     const_per_step_ms = sc * 1e3 / n_steady
+    # the noise band: the CONSTANT arm's own rep spread in the same units
+    # as the overhead it gates — a sub-noise overhead reading is reported
+    # as such instead of asserted (round-4 verdict: 'within rep noise' was
+    # a claim with no variance estimate behind it)
+    steady_noise_ms_per_step = (max(sc_runs) - min(sc_runs)) * 1e3 / n_steady
 
     # diagnostic arm: constant batch + ONE trivial eager op per step.  If
     # its per-step delta matches the iterator arm's, the iterator overhead
@@ -257,14 +264,19 @@ def native_stall(world: int, *, n: int = N_NATIVE, window: int = WINDOW,
     run_fused(False, epoch_base + 20, steps, epochs, True)
     fts = min(run_fused(True, epoch_base + 20 + 7 * r, steps, epochs, True)
               for r in range(1, reps + 1))
-    ftc = min(run_fused(False, epoch_base + 20, steps, epochs, True)
-              for _ in range(reps))
-    fss = min(run_fused(True, epoch_base + 40, n_steady, 1, False)
-              for _ in range(reps))
-    fsc = min(run_fused(False, epoch_base + 40, n_steady, 1, False)
-              for _ in range(reps))
+    ftc_runs = [run_fused(False, epoch_base + 20, steps, epochs, True)
+                for _ in range(reps)]
+    ftc = min(ftc_runs)
+    fss_runs = [run_fused(True, epoch_base + 40, n_steady, 1, False)
+                for _ in range(reps)]
+    fsc_runs = [run_fused(False, epoch_base + 40, n_steady, 1, False)
+                for _ in range(reps)]
+    fss, fsc = min(fss_runs), min(fsc_runs)
     fused_per_step_overhead_ms = max(fss - fsc, 0.0) * 1e3 / n_steady
     fused_const_per_step_ms = fsc * 1e3 / n_steady
+    fused_steady_noise_ms_per_step = (
+        (max(fsc_runs) - min(fsc_runs)) * 1e3 / n_steady
+    )
 
     # epoch boundary, the two ways to account it (min of `reps`, after a
     # warmup rep that absorbs the one-time slice-program compiles):
@@ -307,9 +319,16 @@ def native_stall(world: int, *, n: int = N_NATIVE, window: int = WINDOW,
             ),
             "per_step_overhead_ms": round(fused_per_step_overhead_ms, 4),
             "const_per_step_ms": round(fused_const_per_step_ms, 4),
+            "steady_noise_ms_per_step": round(
+                fused_steady_noise_ms_per_step, 4),
+            "overhead_within_noise": bool(
+                fused_per_step_overhead_ms <= fused_steady_noise_ms_per_step),
             "capped_sampler_wall_s": round(fts, 4),
             "capped_constant_wall_s": round(ftc, 4),
+            "capped_noise_band_s": round(max(ftc_runs) - min(ftc_runs), 4),
             "stall_pct_capped": round(max(fts - ftc, 0.0) / fts * 100.0, 2),
+            "capped_within_noise": bool(
+                abs(fts - ftc) <= max(ftc_runs) - min(ftc_runs)),
         },
         "iterator": {  # the convenience API (one eager slice dispatch/step)
             "stall_pct_epoch": round(
@@ -317,9 +336,14 @@ def native_stall(world: int, *, n: int = N_NATIVE, window: int = WINDOW,
             ),
             "per_step_overhead_ms": round(per_step_overhead_ms, 4),
             "const_per_step_ms": round(const_per_step_ms, 4),
+            "steady_noise_ms_per_step": round(steady_noise_ms_per_step, 4),
+            "overhead_within_noise": bool(
+                per_step_overhead_ms <= steady_noise_ms_per_step),
             "capped_sampler_wall_s": round(ts, 4),
             "capped_constant_wall_s": round(tc, 4),
+            "capped_noise_band_s": round(capped_noise_band_s, 4),
             "stall_pct_capped": round(max(ts - tc, 0.0) / ts * 100.0, 2),
+            "capped_within_noise": bool(abs(ts - tc) <= capped_noise_band_s),
         },
         "extra_eager_dispatch_ms": round(extra_eager_dispatch_ms, 4),
         "boundary_dispatch_ms": round(boundary_dispatch_ms, 3),
@@ -425,6 +449,12 @@ def summarize(worlds=(8, 64, 256),
                 "iterator_stall_pct_epoch": r["iterator"]["stall_pct_epoch"],
                 "fused_per_step_overhead_ms":
                     r["fused"]["per_step_overhead_ms"],
+                "steady_noise_ms_per_step":
+                    r["iterator"]["steady_noise_ms_per_step"],
+                "iterator_overhead_within_noise":
+                    r["iterator"]["overhead_within_noise"],
+                "fused_overhead_within_noise":
+                    r["fused"]["overhead_within_noise"],
                 "extra_eager_dispatch_ms": r["extra_eager_dispatch_ms"],
                 "boundary_dispatch_ms": r["boundary_dispatch_ms"],
                 "regen_completed_ms": r["regen_completed_ms"],

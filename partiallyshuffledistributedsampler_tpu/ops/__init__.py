@@ -34,7 +34,9 @@ def stream_indices_at_jax(*args, **kwargs):
 def ensure_index_backend(backend: str) -> None:
     """Eagerly validate that ``backend`` ('cpu'|'native'|'xla') can serve —
     so consumers fail at construction, not one epoch into a run.  For
-    'native' this loads (or builds) the C++ kernel now."""
+    'native' this loads (or builds) the C++ kernel now; for 'xla' it
+    imports jax now (a box without jax must fail here, not at the first
+    epoch() call)."""
     if backend not in ("cpu", "native", "xla"):
         raise ValueError(
             f"backend must be 'cpu', 'native' or 'xla', got {backend!r}"
@@ -44,6 +46,13 @@ def ensure_index_backend(backend: str) -> None:
 
         if not native.available():
             native.build()
+    elif backend == "xla":
+        try:
+            import jax  # noqa: F401
+        except Exception as exc:
+            raise ValueError(
+                f"backend 'xla' needs jax, which failed to import: {exc!r}"
+            ) from None
 
 
 def epoch_indices_host(backend: str, n, window, seed, epoch, rank, world,

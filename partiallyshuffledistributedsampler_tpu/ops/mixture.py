@@ -173,10 +173,56 @@ class MixtureSpec:
         self.bases = tuple(int(b) for b in bases)
         self.total_sources_len = int(sum(self.sources))
 
+    #: block-size cap for the [B, B] packed rotation table (16 MB at the
+    #: cap); bigger blocks fall back to the per-lane chained gathers
+    _PACK_B_CAP = 2048
+
     # ------------------------------------------------------------------ info
     @property
     def num_sources(self) -> int:
         return len(self.sources)
+
+    def packed_slot_table(self):
+        """[B] uint32: ``pattern[t] | C_pattern[t](t) << 8`` — the fused
+        evaluator's v1 (unrotated) lane parameters in ONE gather instead
+        of a chained pattern+prefix pair (each full-width gather measured
+        ~3x a whole 24-round bijection pass on the bench device).  None
+        when S >= 256 (the source id must fit the low byte)."""
+        cached = getattr(self, "_packed_slot", None)
+        if cached is None:
+            if self.num_sources >= 256:
+                return None
+            t = np.arange(self.block)
+            c_own = self.prefix[t, self.pattern]  # C_s(t) for s = pattern[t]
+            cached = (self.pattern.astype(np.uint32)
+                      | (c_own.astype(np.uint32) << np.uint32(8)))
+            cached.setflags(write=False)
+            object.__setattr__(self, "_packed_slot", cached)
+        return cached
+
+    def packed_rot_table(self):
+        """[B * B] uint32, row-major over (rot, slot):
+        ``pattern[slot] | cnt(rot, slot) << 8`` with ``cnt`` the §8.2a
+        circular prefix count ``C_s(slot) - C_s(rot) + (slot < rot)*k_s``
+        for ``s = pattern[slot]`` — the v2 rotated lane parameters in ONE
+        gather.  None when S >= 256 or B > _PACK_B_CAP (table memory)."""
+        cached = getattr(self, "_packed_rot", None)
+        if cached is None:
+            if self.num_sources >= 256 or self.block > self._PACK_B_CAP:
+                return None
+            B = self.block
+            t = np.arange(B)
+            pat = self.pattern
+            c_own = self.prefix[t, pat]          # [B]  C_s(slot), s own
+            c_r = self.prefix[:, pat]            # [B(rot), B(slot)]
+            k_own = np.asarray(self.quotas)[pat]  # [B]
+            wrap = t[None, :] < t[:, None]       # slot < rot <=> wrapped
+            cnt = c_own[None, :] - c_r + wrap * k_own[None, :]
+            cached = (pat[None, :].astype(np.uint32)
+                      | (cnt.astype(np.uint32) << np.uint32(8))).reshape(-1)
+            cached.setflags(write=False)
+            object.__setattr__(self, "_packed_rot", cached)
+        return cached
 
     def key(self) -> tuple:
         """Hashable identity (compiled-program cache key, checkpoint field)."""
@@ -282,20 +328,71 @@ class MixtureSpec:
 _TABLE_CAP = 8_000_000
 
 
+#: class-count cap for the per-round select chain: beyond this, the
+#: pairing-constant broadcast falls back to one gather per round
+_SELECT_CAP = 8
+
+#: lane-count cap for the [B, B] packed rotation table (one 4 MB-table
+#: gather); beyond it the two-tiny-table variant wins (measured on the
+#: bench device: 31M lanes — packed 297 ms vs tiny 705; 125M lanes —
+#: packed 3142 vs tiny 2607: the big table's cache behavior inverts
+#: between those, so the cap sits at 64M)
+_ROT_PACK_LANES_CAP = 1 << 26
+
+
+def _lane_divmod(xp, masks, x, divisors, idx):
+    """``(x // d, x % d)`` with a per-CLASS static divisor: one constant
+    division per class (which the compiler strength-reduces to
+    multiply-shift) selected through the class masks — a per-lane vector
+    division has no fast integer lowering on the TPU VPU and measured as
+    the dominant cost of the fused evaluation.  Falls back to the true
+    vector division when the class count exceeds the select cap."""
+    if masks is None:
+        d = xp.take(xp.asarray(np.asarray(divisors)).astype(x.dtype), idx)
+        return x // d, x % d
+    q = r = None
+    for c in range(len(masks)):
+        d = xp.asarray(int(divisors[c]), dtype=x.dtype)
+        qc = x // d
+        rc = x - qc * d
+        if q is None:
+            q, r = qc, rc
+        else:
+            q = xp.where(masks[c], qc, q)
+            r = xp.where(masks[c], rc, r)
+    return q, r
+
+
+def _lane_broadcast(xp, masks, vec, idx):
+    """Broadcast the [M]-entry per-class vector ``vec`` to lanes: a
+    where-select chain over the precomputed class ``masks`` when the
+    class count is small (selects are plain VPU lane ops — measured far
+    cheaper than a gather per round at production lane counts), one
+    ``take`` otherwise."""
+    if masks is not None:
+        out = vec[len(masks) - 1]
+        for c in range(len(masks) - 2, -1, -1):
+            out = xp.where(masks[c], vec[c], out)
+        return out
+    return xp.take(vec, idx)
+
+
 def _swap_or_not_lanes(xp, x, m_lane, msafe_src, key_lane, pair_src,
-                       rounds: int, s_arr):
-    """swap-or-not with a PER-LANE modulus gathered from per-source
+                       rounds: int, s_arr, masks=None):
+    """swap-or-not with a PER-LANE modulus broadcast from per-class
     tables — the engine of the fused mixture evaluation.
 
     Bit-identical per lane to ``core.swap_or_not(x, m, key, pair_key)``
     with that lane's ``(m, pair_key)``: the per-round pairing constants
-    ``K_r = mix32(pair_key ^ r*GOLDEN) % m`` depend only on (source,
-    round), so they are computed on the tiny ``[S]`` source vectors and
-    gathered per lane — the per-lane round work stays division-free
+    ``K_r = mix32(pair_key ^ r*GOLDEN) % m`` depend only on (class,
+    round), so they are computed on the tiny per-class vectors and
+    broadcast per lane (select chain / gather, ``_lane_broadcast``) —
+    the per-lane round work stays division- and gather-free
     (add/compare/select + one mix32), exactly like the scalar-m core.
     Lanes with ``m <= 1`` pass through unchanged (core's early return);
-    ``msafe_src`` is the [S] modulus vector with zeros lifted to 1 so the
-    table computation never divides by zero (those sources own no lanes).
+    ``msafe_src`` is the per-class modulus vector with zeros lifted to 1
+    so the table computation never divides by zero (those classes own no
+    lanes).
     """
     key2 = core.mix32(xp, key_lane ^ core._u32(xp, core._C_BIT))
     one = core._u32(xp, 1)
@@ -304,7 +401,7 @@ def _swap_or_not_lanes(xp, x, m_lane, msafe_src, key_lane, pair_src,
         kr_src = core.mix32(
             xp, pair_src ^ core._u32(xp, (r * core._GOLDEN) & core._M32)
         ) % msafe_src
-        k_r = xp.take(kr_src, s_arr)
+        k_r = _lane_broadcast(xp, masks, kr_src, s_arr)
         partner = k_r + (m_lane - x)
         partner = xp.where(partner >= m_lane, partner - m_lane, partner)
         c = xp.where(x > partner, x, partner)
@@ -315,16 +412,23 @@ def _swap_or_not_lanes(xp, x, m_lane, msafe_src, key_lane, pair_src,
     return x
 
 
-def _fused_mixture_eval(xp, spec: MixtureSpec, s_arr, slot, rot, wrap, blk,
+def _fused_mixture_eval(xp, spec: MixtureSpec, slot, rot, wrap, blk,
                         seed, epoch, order_windows: bool, rounds: int,
                         pos_dtype, out_dtype):
     """Single-pass evaluation of the §8.3 stream: ONE §3 program over all
-    lanes with per-lane (n, W, nw, tail, keys) gathered from [S] tables,
+    lanes with per-lane (n, W, nw, tail, keys) broadcast from [S] tables,
     instead of S masked full-lane passes — O(len) total work independent
     of the source count.  Bit-identical to the masked per-source loop by
     construction (same bijections, same keys, per-lane instead of
     per-source evaluation); requires every ``n_s < 2^31`` so the
     per-source position math fits uint32.
+
+    The lane parameters ``(source, within-block draw count)`` come from
+    ONE packed-table gather (``MixtureSpec.packed_slot_table`` /
+    ``packed_rot_table``) — full-width gathers measured ~3x a whole
+    24-round bijection pass on the bench device, so the chained
+    pattern+prefix(+rotated prefix) lookups were the dominant cost of the
+    first fused cut; the packed tables collapse them to one.
     """
     S = spec.num_sources
     n_np = np.asarray(spec.sources, dtype=np.int64)
@@ -332,34 +436,88 @@ def _fused_mixture_eval(xp, spec: MixtureSpec, s_arr, slot, rot, wrap, blk,
     nw_np = n_np // w_np          # >= 1: windows are capped at n_s
     body_np = nw_np * w_np
     tail_np = n_np - body_np      # in [0, W_s)
-    s_i32 = s_arr.astype(xp.int32)
 
-    def tab_u32(vals):
-        return xp.take(
-            xp.asarray(np.asarray(vals, dtype=np.uint32)), s_i32
+    # ---- lane parameters: source id + within-block draw count -----------
+    # strategy: ONE gather from the [B, B] packed rotation table when the
+    # lane count is moderate (its 4 MB working set measured faster than
+    # chained tiny-table gathers there), TWO tiny-table gathers (packed
+    # [B] slot table + [B*S] prefix-at-rot) at huge lane counts, where
+    # the big table's cache behavior inverted the win on the bench device
+    lanes = int(np.prod(np.shape(slot)))
+    packed_np = None
+    if rot is None:
+        packed_np = spec.packed_slot_table()
+        rot_small = None
+    elif lanes <= _ROT_PACK_LANES_CAP:
+        packed_np = spec.packed_rot_table()
+        rot_small = None
+    else:
+        rot_small = spec.packed_slot_table()
+    if packed_np is not None:
+        if rot is None:
+            gidx = slot
+        else:
+            gidx = rot * spec.block + slot
+        packed = xp.take(xp.asarray(packed_np), gidx)
+        s_i32 = (packed & core._u32(xp, 0xFF)).astype(xp.int32)
+        cnt = (packed >> core._u32(xp, 8)).astype(xp.int32)
+    elif rot_small is not None:
+        packed = xp.take(xp.asarray(rot_small), slot)
+        s_i32 = (packed & core._u32(xp, 0xFF)).astype(xp.int32)
+        c_slot = (packed >> core._u32(xp, 8)).astype(xp.int32)
+        pf32 = xp.asarray(
+            np.ascontiguousarray(spec.prefix.astype(np.int32).reshape(-1))
         )
-
-    # ---- per-lane draw ordinal j (the quota law, per-lane) --------------
-    # prefix counts in int32: every count is < B
-    pf32 = xp.asarray(
-        np.ascontiguousarray(spec.prefix.astype(np.int32).reshape(-1))
-    )
-    q32 = xp.asarray(np.asarray(spec.quotas, dtype=np.int32))
-    cnt = xp.take(pf32, slot * S + s_i32)
-    if rot is not None:
+        q32 = np.asarray(spec.quotas, dtype=np.int32)
+        k_i32 = xp.take(xp.asarray(q32), s_i32) if S > _SELECT_CAP else None
+        if k_i32 is None:
+            k_i32 = q32[S - 1]
+            for s in range(S - 2, -1, -1):
+                k_i32 = xp.where(s_i32 == s, q32[s], k_i32)
         cnt = (
-            cnt
-            + xp.where(wrap, xp.take(q32, s_i32),
-                       xp.asarray(0, dtype=xp.int32))
+            c_slot
+            + xp.where(wrap, k_i32, xp.asarray(0, dtype=xp.int32))
             - xp.take(pf32, rot * S + s_i32)
         )
-    k_lane = xp.take(
-        xp.asarray(np.asarray(spec.quotas)).astype(pos_dtype), s_i32
-    )
-    n_lane = xp.take(xp.asarray(n_np).astype(pos_dtype), s_i32)
+    else:
+        s_i32 = None  # chained fallback below (needs the class masks)
+
+    if s_i32 is None:
+        s_i32 = xp.take(
+            xp.asarray(np.asarray(spec.pattern)), slot
+        ).astype(xp.int32)
+    # class masks, computed ONCE: every per-lane parameter (and the 24x2
+    # per-round pairing constants) broadcasts through these as a select
+    # chain — gather-free lanes for small S, the production shape
+    if S <= _SELECT_CAP:
+        masks = [s_i32 == xp.asarray(s, dtype=xp.int32) for s in range(S)]
+    else:
+        masks = None
+
+    def lane(vals, dtype):
+        return _lane_broadcast(
+            xp, masks, xp.asarray(np.asarray(vals)).astype(dtype), s_i32
+        )
+
+    if packed_np is None and rot_small is None:
+        # chained-gather fallback (S >= 256 or an oversized block):
+        # prefix counts in int32 — every count is < B
+        pf32 = xp.asarray(
+            np.ascontiguousarray(spec.prefix.astype(np.int32).reshape(-1))
+        )
+        cnt = xp.take(pf32, slot * S + s_i32)
+        if rot is not None:
+            cnt = (
+                cnt
+                + xp.where(wrap, lane(spec.quotas, xp.int32),
+                           xp.asarray(0, dtype=xp.int32))
+                - xp.take(pf32, rot * S + s_i32)
+            )
+    k_lane = lane(spec.quotas, pos_dtype)
     j = blk * k_lane + cnt.astype(pos_dtype)
-    pas = (j // n_lane).astype(xp.uint32)
-    u = (j % n_lane).astype(xp.uint32)
+    pas_w, u_w = _lane_divmod(xp, masks, j, n_np, s_i32)
+    pas = pas_w.astype(xp.uint32)
+    u = u_w.astype(xp.uint32)
 
     # ---- per-source seeds and pairing keys (§8.3), on [S] vectors -------
     d = np.asarray(
@@ -376,51 +534,70 @@ def _fused_mixture_eval(xp, spec: MixtureSpec, s_arr, slot, rot, wrap, blk,
     # per-lane decision keys: the pass-folded epoch (§8.3) varies per lane
     ep_u = core.mix32(xp, ep ^ core.mix32(xp, pas ^ core._u32(xp, _C_PASS)))
     ek_lane = core.derive_epoch_key(
-        xp, (xp.take(lo_s, s_i32), xp.take(hi_s, s_i32)), ep_u
+        xp,
+        (_lane_broadcast(xp, masks, lo_s, s_i32),
+         _lane_broadcast(xp, masks, hi_s, s_i32)),
+        ep_u,
     )
 
     # ---- the §3 law, per-lane -------------------------------------------
-    w_u = tab_u32(w_np)
-    nw_u = tab_u32(nw_np)
-    body_u = tab_u32(body_np)
+    w_u = lane(w_np, xp.uint32)
+    body_u = lane(body_np, xp.uint32)
     nw_safe = np.maximum(nw_np, 1).astype(np.uint32)
     w_safe = np.maximum(w_np, 1).astype(np.uint32)
     tail_safe = np.maximum(tail_np, 1).astype(np.uint32)
-    win = u // w_u
-    lim = nw_u - core._u32(xp, 1)
+    win, r0 = _lane_divmod(xp, masks, u, w_np, s_i32)
+    lim = lane(nw_np, xp.uint32) - core._u32(xp, 1)
     win = xp.where(win > lim, lim, win)  # tail lanes clipped, masked below
-    r0 = u % w_u
     if order_windows:
         k = _swap_or_not_lanes(
-            xp, win, nw_u, xp.asarray(nw_safe),
+            xp, win, lane(nw_np, xp.uint32), xp.asarray(nw_safe),
             core.outer_key(xp, ek_lane), core.outer_key(xp, ek0_src),
-            rounds, s_i32,
+            rounds, s_i32, masks,
         )
     else:
         k = win
     kin = core.inner_key(xp, ek_lane, k)
-    rho = _swap_or_not_lanes(
-        xp, r0, w_u, xp.asarray(w_safe), kin,
-        core.inner_pair_key(xp, ek0_src), rounds, s_i32,
-    )
-    body_idx = k * w_u + rho
     if (tail_np > 0).any():
-        tail_u = tab_u32(tail_np)
-        tpos = xp.where(u >= body_u, u - body_u, core._u32(xp, 0))
-        tlim = tab_u32(tail_safe) - core._u32(xp, 1)
+        # MERGED inner+tail pass: a lane is either a body lane (inner
+        # bijection over [0, W_s), key kin) or a tail lane (tail
+        # bijection over [0, tail_s)); the swap-or-not loop is the same
+        # algorithm either way, so both ride ONE pass with per-lane
+        # (m, key) and a [2S]-class pairing table — tail lanes are a
+        # vanishing fraction at production shapes, and a dedicated
+        # full-width tail pass cost a third of the whole evaluation
+        is_tail = u >= body_u
+        tail_u = lane(tail_np, xp.uint32)
+        tpos = xp.where(is_tail, u - body_u, core._u32(xp, 0))
+        tlim = lane(tail_safe, xp.uint32) - core._u32(xp, 1)
         tpos = xp.where(tpos > tlim, tlim, tpos)
-        rho_t = _swap_or_not_lanes(
-            xp, tpos, tail_u, xp.asarray(tail_safe),
-            core.tail_key(xp, ek_lane), core.tail_key(xp, ek0_src),
-            rounds, s_i32,
+        m2 = xp.where(is_tail, tail_u, w_u)
+        x0 = xp.where(is_tail, tpos, r0)
+        key2 = xp.where(is_tail, core.tail_key(xp, ek_lane), kin)
+        pair2 = xp.concatenate([
+            core.inner_pair_key(xp, ek0_src), core.tail_key(xp, ek0_src),
+        ])
+        msafe2 = np.concatenate([w_safe, tail_safe])
+        if masks is not None:
+            masks2 = [m & ~is_tail for m in masks] \
+                + [m & is_tail for m in masks]
+            idx2 = s_i32
+        else:
+            masks2 = None
+            idx2 = s_i32 + xp.where(is_tail, xp.asarray(S, xp.int32),
+                                    xp.asarray(0, xp.int32))
+        rho = _swap_or_not_lanes(
+            xp, x0, m2, xp.asarray(msafe2), key2, pair2, rounds, idx2,
+            masks2,
         )
-        idx = xp.where(u < body_u, body_idx, body_u + rho_t)
+        idx = xp.where(is_tail, body_u + rho, k * w_u + rho)
     else:
-        idx = body_idx
-    base_lane = xp.take(
-        xp.asarray(np.asarray(spec.bases)).astype(out_dtype), s_i32
-    )
-    return base_lane + idx.astype(out_dtype)
+        rho = _swap_or_not_lanes(
+            xp, r0, w_u, xp.asarray(w_safe), kin,
+            core.inner_pair_key(xp, ek0_src), rounds, s_i32, masks,
+        )
+        idx = k * w_u + rho
+    return lane(spec.bases, out_dtype) + idx.astype(out_dtype)
 
 
 def _amortized_source_perm(xp, u, pas, n_s, W, seed_pair, ep, P,
@@ -593,7 +770,6 @@ def mixture_stream_at_generic(
         rot = None
         wrap = None
         slot = t
-    s_arr = xp.take(pattern, slot)
     fused_ok = shuffle and max(spec.sources) <= 0x7FFFFFFF
     if fused is None:
         use_fused = fused_ok
@@ -606,9 +782,10 @@ def mixture_stream_at_generic(
             )
     if use_fused:
         return _fused_mixture_eval(
-            xp, spec, s_arr, slot, rot, wrap, blk, seed, epoch,
+            xp, spec, slot, rot, wrap, blk, seed, epoch,
             order_windows, rounds, pos_dtype, out_dtype,
         )
+    s_arr = xp.take(pattern, slot)
     out = xp.zeros(p.shape, dtype=out_dtype)
     for s in range(spec.num_sources):
         n_s = spec.sources[s]

@@ -10,8 +10,19 @@ background thread so the gather and the host→device wire hide behind the
 device's compute — the same overlap DataLoader workers buy torch users,
 without processes, pickling, or a collate function.
 
-Determinism: batches are exactly the sampler stream
-(``epoch_indices_np(n, window, seed, epoch, rank, world)``) cut into
+Every stream the framework serves rides through the same loader:
+
+* the single-source §3/§4 stream (default),
+* the weighted **mixture** stream (``mixture=MixtureSpec(...)``, SPEC.md
+  §8 — the multi-corpus pretrain shape, with ``data`` either one
+  concatenated pytree or one pytree per source),
+* the **shard-index** stream (``shard_sizes=[...]``, SPEC.md §7 — shard
+  order windowed-shuffled, expanded to sample indices per epoch),
+* the **elastic remainder** epoch after a world-size change
+  (``epoch(e, layers=[(old_world, consumed), ...])``, SPEC.md §6 — for
+  the single-source and mixture streams).
+
+Determinism: batches are exactly the corresponding sampler stream cut into
 ``batch``-sized slices — bit-identical to every other consumer surface of
 the same config, so checkpoints interoperate (resume with ``start_step``).
 """
@@ -38,7 +49,9 @@ class HostDataLoader:
             for batch in loader.epoch(epoch):      # {"x": dev, "y": dev}
                 state = train_step(state, batch)   # gather+wire hidden
 
-    data: a dict (or single array) of host arrays sharing leading dim n.
+    data: a dict (or single array) of host arrays sharing leading dim n —
+        or, with ``mixture``, a LIST of per-source dicts/arrays (leading
+        dims ``spec.sources``) gathered via ``spec.decompose``.
     depth: prefetch queue capacity; up to ``depth + 1`` gathered batches
         are live at once (the producer holds one more while the queue is
         full).  The default 1 therefore double-buffers.
@@ -46,7 +59,20 @@ class HostDataLoader:
         kernel), 'xla' (device regen + one host readback per epoch —
         only worth it when the rank's shard is large), or 'auto'
         (cost-based pick per shard size, utils/autotune — the same rule
-        as the torch shim's ``backend='auto'``).
+        as the torch shim's ``backend='auto'``).  The mixture stream has
+        no native kernel: 'native' is rejected there and 'auto' resolves
+        between 'cpu' and 'xla'.
+    mixture: a ``MixtureSpec`` — serve the §8 stream (global ids into the
+        concatenated source space); ``epoch_samples`` sets the mixture
+        epoch length T.  Mutually exclusive with ``shard_sizes``;
+        ``window`` is carried by the spec and must be omitted.
+    shard_sizes: per-shard sample counts — serve the §7 shard-index
+        stream: the rank's shard order (windowed over ``window`` shard
+        slots, default 64) expanded to global sample indices
+        (``within_shard_shuffle`` as in shard_mode).  Note the per-epoch
+        sample count varies with the rank's shard draw, so
+        ``steps_per_epoch`` is None; ``loader.epoch_steps(e)`` gives the
+        exact count.
     drop_last_batch: as in DeviceEpochIterator; False serves the trailing
         partial batch.
     device: target for ``jax.device_put`` (default: default device).
@@ -59,7 +85,7 @@ class HostDataLoader:
         self,
         data,
         *,
-        window: int,
+        window: Optional[int] = None,
         batch: int,
         seed: int = 0,
         rank: int = 0,
@@ -68,28 +94,117 @@ class HostDataLoader:
         index_backend: str = "cpu",
         drop_last_batch: bool = True,
         device=None,
+        mixture=None,
+        epoch_samples: Optional[int] = None,
+        shard_sizes=None,
+        within_shard_shuffle=True,
         **kwargs,
     ) -> None:
+        if mixture is not None and shard_sizes is not None:
+            raise ValueError(
+                "mixture and shard_sizes are mutually exclusive streams"
+            )
+        self.mixture = mixture
+        self.shard_sizes = (
+            None if shard_sizes is None
+            else np.asarray(shard_sizes, dtype=np.int64)
+        )
+        self.within_shard_shuffle = within_shard_shuffle
+        self.epoch_samples = (
+            None if epoch_samples is None else int(epoch_samples)
+        )
+        self._source_data = None
+        if mixture is not None:
+            from ..ops.mixture import MixtureSpec
+
+            if not isinstance(mixture, MixtureSpec):
+                raise TypeError(
+                    f"mixture must be a MixtureSpec, got "
+                    f"{type(mixture).__name__}"
+                )
+            if window is not None:
+                raise ValueError(
+                    "window is carried by the MixtureSpec (per-source "
+                    "windows); omit it for mixture loaders"
+                )
+            window = 1  # unused by the mixture stream
+            data, self._source_data, bare_sources = (
+                self._normalize_mixture_data(data, mixture)
+            )
+        else:
+            bare_sources = False
+            if epoch_samples is not None:
+                raise ValueError(
+                    "epoch_samples applies to mixture loaders only"
+                )
         self.data = data if isinstance(data, dict) else {"data": data}
         if not self.data:
             raise ValueError("data must contain at least one array")
         lens = {k: int(np.shape(v)[0]) for k, v in self.data.items()}
         if len(set(lens.values())) != 1:
             raise ValueError(f"leading dims differ: {lens}")
-        self.n = next(iter(lens.values()))
-        self._single = not isinstance(data, dict)
+        self.n_rows = next(iter(lens.values()))
+        self._single = bare_sources or not isinstance(data, dict)
+        if self.shard_sizes is not None:
+            if window is None:
+                window = 64  # the shard sampler's locality default
+            self.shard_offsets = np.concatenate(
+                [[0], np.cumsum(self.shard_sizes)[:-1]]
+            )
+            total = int(self.shard_sizes.sum())
+            if total != self.n_rows:
+                raise ValueError(
+                    f"shard_sizes sum to {total} but data has "
+                    f"{self.n_rows} rows"
+                )
+            self.n = len(self.shard_sizes)  # the index space is SHARDS
+        elif mixture is not None:
+            if mixture.total_sources_len != self.n_rows:
+                raise ValueError(
+                    f"mixture sources sum to {mixture.total_sources_len} "
+                    f"but data has {self.n_rows} rows"
+                )
+            self.n = (
+                mixture.total_sources_len if self.epoch_samples is None
+                else self.epoch_samples
+            )
+        else:
+            if window is None:
+                raise ValueError("window is required (single-source stream)")
+            self.n = self.n_rows
         if not 0 <= rank < world:
             raise ValueError(f"rank must be in [0, {world}), got {rank}")
         if depth < 1:
             raise ValueError(f"depth must be >= 1, got {depth}")
         self._auto_cost = None
+        num_samples, _ = core.shard_sizes(
+            self.n, world, kwargs.get("drop_last", False)
+        )
         if index_backend == "auto":
-            from ..utils.autotune import pick_backend
+            if mixture is not None:
+                # the cost model prices the SINGLE-SOURCE evaluator; the
+                # mixture stream's per-sample costs differ ~10x on both
+                # arms, so 'auto' stays host-side here (pass 'xla'
+                # explicitly to pin the device path)
+                index_backend = "cpu"
+            elif self.shard_sizes is not None:
+                # the shard-ID stream 'auto' would price is the trivial
+                # part; the dominant cost is the O(total-samples) host
+                # expansion, which no backend choice moves
+                from ..ops import native as _native
 
-            num_samples, _ = core.shard_sizes(
-                self.n, world, kwargs.get("drop_last", False)
+                index_backend = (
+                    "native" if _native.available() else "cpu"
+                )
+            else:
+                from ..utils.autotune import pick_backend
+
+                index_backend, self._auto_cost = pick_backend(num_samples)
+        if mixture is not None and index_backend == "native":
+            raise ValueError(
+                "index_backend: the mixture stream has no native kernel; "
+                "use 'cpu', 'xla', or 'auto'"
             )
-            index_backend, self._auto_cost = pick_backend(num_samples)
         try:
             ensure_index_backend(index_backend)  # incl. native build, eagerly
         except ValueError as exc:
@@ -101,51 +216,206 @@ class HostDataLoader:
         self.drop_last_batch = bool(drop_last_batch)
         self.device = device
         self.kwargs = kwargs
-        self.num_samples, _ = core.shard_sizes(
-            self.n, world, kwargs.get("drop_last", False)
-        )
-        if drop_last_batch:
-            self.steps_per_epoch = self.num_samples // self.batch
+        self.num_samples = num_samples
+        if self.shard_sizes is not None:
+            # the per-epoch SAMPLE count follows the rank's shard draw
+            self.steps_per_epoch: Optional[int] = None
         else:
-            self.steps_per_epoch = -(-self.num_samples // self.batch)
-        if self.steps_per_epoch == 0:
+            if drop_last_batch:
+                self.steps_per_epoch = self.num_samples // self.batch
+            else:
+                self.steps_per_epoch = -(-self.num_samples // self.batch)
+            if self.steps_per_epoch == 0:
+                raise ValueError(
+                    f"batch={batch} exceeds the rank's "
+                    f"{self.num_samples} samples"
+                )
+
+    @staticmethod
+    def _normalize_mixture_data(data, spec):
+        """Accept per-source data (list/tuple, one pytree per source) or
+        one concatenated pytree; returns ``(dict_form, source_list,
+        bare)`` where ``source_list`` is None for concatenated data and
+        ``bare`` records that the sources were plain arrays (batches are
+        then served unwrapped, like a plain-array loader)."""
+        if not isinstance(data, (list, tuple)):
+            return data, None, False
+        if len(data) != spec.num_sources:
             raise ValueError(
-                f"batch={batch} exceeds the rank's {self.num_samples} samples"
+                f"{spec.num_sources} sources but {len(data)} data entries"
             )
+        per_source = [
+            d if isinstance(d, dict) else {"data": d} for d in data
+        ]
+        keys = set(per_source[0])
+        for i, d in enumerate(per_source):
+            if set(d) != keys:
+                raise ValueError(
+                    f"source {i} keys {sorted(d)} != source 0 keys "
+                    f"{sorted(keys)}"
+                )
+            for k, v in d.items():
+                if int(np.shape(v)[0]) != spec.sources[i]:
+                    raise ValueError(
+                        f"source {i} array {k!r} has "
+                        f"{int(np.shape(v)[0])} rows; spec says "
+                        f"{spec.sources[i]}"
+                    )
+        # a zero-copy stand-in dict keyed like the sources: the loader's
+        # generic plumbing only reads its keys and (summed) length
+        proto = {
+            k: _ConcatView([d[k] for d in per_source])
+            for k in per_source[0]
+        }
+        bare = not isinstance(data[0], dict)
+        return proto, per_source, bare
 
     # ------------------------------------------------------------- indices
-    def epoch_indices(self, epoch: int) -> np.ndarray:
-        return epoch_indices_host(
-            self.index_backend, self.n, self.window, self.seed, epoch,
-            self.rank, self.world, **self.kwargs,
+    def epoch_indices(self, epoch: int, layers=None) -> np.ndarray:
+        """This rank's epoch stream as host sample indices — the exact
+        sampler stream for the loader's config (elastic remainder when
+        ``layers`` names a §6 reshard cascade).  One-entry cached per
+        (epoch, layers): the documented shard-mode pattern calls
+        ``epoch_steps(e)`` then ``epoch(e)``, and the streams are pure,
+        so the second O(num_samples) regen+expansion would be pure
+        waste."""
+        key = (int(epoch),
+               None if layers is None
+               else tuple((int(w), int(c)) for w, c in layers))
+        cached = getattr(self, "_idx_cache", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        idx = self._compute_epoch_indices(epoch, layers)
+        self._idx_cache = (key, idx)
+        return idx
+
+    def _compute_epoch_indices(self, epoch: int, layers) -> np.ndarray:
+        if self.mixture is not None:
+            return self._mixture_indices(epoch, layers)
+        base = self._base_indices(epoch, layers)
+        if self.shard_sizes is None:
+            return base
+        from .shard_mode import expand_shard_indices_np
+
+        return expand_shard_indices_np(
+            base, self.shard_sizes, seed=self.seed, epoch=epoch,
+            within_shard_shuffle=self.within_shard_shuffle,
+            rounds=self.kwargs.get("rounds", core.DEFAULT_ROUNDS),
         )
 
+    def _base_indices(self, epoch: int, layers) -> np.ndarray:
+        if layers is None:
+            return epoch_indices_host(
+                self.index_backend, self.n, self.window, self.seed, epoch,
+                self.rank, self.world, **self.kwargs,
+            )
+        from ..ops.cpu import elastic_indices_np
+
+        return elastic_indices_np(
+            self.n, self.window, self.seed, epoch, self.rank, self.world,
+            list(layers),
+            shuffle=self.kwargs.get("shuffle", True),
+            drop_last=self.kwargs.get("drop_last", False),
+            order_windows=self.kwargs.get("order_windows", True),
+            partition=self.kwargs.get("partition", "strided"),
+            rounds=self.kwargs.get("rounds", core.DEFAULT_ROUNDS),
+        )
+
+    def _mixture_indices(self, epoch: int, layers) -> np.ndarray:
+        from ..ops import mixture as M
+
+        kw = dict(
+            epoch_samples=self.epoch_samples,
+            shuffle=self.kwargs.get("shuffle", True),
+            drop_last=self.kwargs.get("drop_last", False),
+            order_windows=self.kwargs.get("order_windows", True),
+            partition=self.kwargs.get("partition", "strided"),
+            rounds=self.kwargs.get("rounds", core.DEFAULT_ROUNDS),
+        )
+        if layers is not None:
+            if self.index_backend == "xla":
+                return np.asarray(M.mixture_elastic_indices_jax(
+                    self.mixture, self.seed, epoch, self.rank, self.world,
+                    list(layers), **kw,
+                ))
+            return M.mixture_elastic_indices_np(
+                self.mixture, self.seed, epoch, self.rank, self.world,
+                list(layers), **kw,
+            )
+        if self.index_backend == "xla":
+            return np.asarray(M.mixture_epoch_indices_jax(
+                self.mixture, self.seed, epoch, self.rank, self.world, **kw,
+            ))
+        return M.mixture_epoch_indices_np(
+            self.mixture, self.seed, epoch, self.rank, self.world, **kw,
+        )
+
+    # -------------------------------------------------------------- gather
+    def _gather(self, sl: np.ndarray) -> dict:
+        if self._source_data is None:
+            return {
+                k: np.take(v, sl, axis=0) for k, v in self.data.items()
+            }
+        s, loc = self.mixture.decompose(sl)
+        out = {}
+        for k in self.data:
+            parts = self._source_data
+            first = np.asarray(parts[0][k][:1])
+            buf = np.empty((len(sl),) + first.shape[1:], dtype=first.dtype)
+            for si in range(self.mixture.num_sources):
+                m = s == si
+                if m.any():
+                    buf[m] = np.take(parts[si][k], loc[m], axis=0)
+            out[k] = buf
+        return out
+
+    # -------------------------------------------------------------- sizing
+    def _steps_for(self, n_idx: int) -> int:
+        if self.drop_last_batch:
+            return n_idx // self.batch
+        return -(-n_idx // self.batch)
+
+    def epoch_steps(self, epoch: int, layers=None) -> int:
+        """Exact step count ``epoch(epoch, layers=...)`` will serve —
+        needed for shard-mode streams, whose per-epoch sample count
+        follows the rank's shard draw."""
+        return self._steps_for(len(self.epoch_indices(epoch, layers)))
+
     # -------------------------------------------------------------- epochs
-    def epoch(self, epoch: int, *, start_step: int = 0) -> Iterator:
+    def epoch(self, epoch: int, *, start_step: int = 0,
+              layers=None) -> Iterator:
         """Device batches for ``epoch``, prefetched ``depth`` steps ahead.
 
         ``start_step`` resumes mid-epoch (e.g. from a checkpointed step
         count): batches ``start_step..`` are served, identical to the
-        tail of an uninterrupted epoch.
+        tail of an uninterrupted epoch.  ``layers`` switches the stream
+        to the §6 elastic REMAINDER of the epoch after a world-size
+        change (``[(old_world, consumed), ...]`` outermost first, as
+        everywhere in the framework); subsequent epochs are ordinary
+        full epochs at this loader's world size.
         """
         # validate eagerly AT THE CALL — this method returns a generator,
-        # and a deferred error would fire wherever the caller first pulls it
-        if not 0 <= start_step <= self.steps_per_epoch:
+        # and a deferred error would fire wherever the caller first pulls
+        # it.  The index stream is computed here for the same reason
+        # (start_step bounds depend on it for shard/elastic streams).
+        idx = self.epoch_indices(epoch, layers)
+        steps = self._steps_for(len(idx))
+        if not 0 <= start_step <= steps:
             raise ValueError(
-                f"start_step {start_step} outside [0, {self.steps_per_epoch}]"
+                f"start_step {start_step} outside [0, {steps}]"
             )
-        return self._epoch_gen(epoch, start_step)
+        return self._epoch_gen(idx, steps, start_step)
 
-    def _epoch_gen(self, epoch: int, start_step: int) -> Iterator:
+    def _epoch_gen(self, idx: np.ndarray, steps: int,
+                   start_step: int) -> Iterator:
         import jax
 
-        idx = self.epoch_indices(epoch)
         q: queue.Queue = queue.Queue(maxsize=self.depth)
         stop = threading.Event()
 
         def produce() -> None:
             try:
-                for s in range(start_step, self.steps_per_epoch):
+                for s in range(start_step, steps):
                     if stop.is_set():
                         return
                     lo = s * self.batch
@@ -154,8 +424,8 @@ class HostDataLoader:
                     # returns immediately; the wire runs while the device
                     # computes earlier steps
                     out = {
-                        k: jax.device_put(np.take(v, sl, axis=0), self.device)
-                        for k, v in self.data.items()
+                        k: jax.device_put(v, self.device)
+                        for k, v in self._gather(sl).items()
                     }
                     if self._single:
                         out = out["data"]
@@ -201,3 +471,17 @@ class HostDataLoader:
                 except queue.Empty:
                     break
             t.join(timeout=5.0)
+
+
+class _ConcatView:
+    """Zero-copy stand-in for concatenated per-source arrays: only the
+    leading length (the sum) and ``np.shape`` are ever read by the
+    loader's generic plumbing; gathers go through the per-source path."""
+
+    def __init__(self, parts) -> None:
+        self._parts = parts
+        self._len = int(sum(int(np.shape(p)[0]) for p in parts))
+        self.shape = (self._len,) + tuple(np.shape(parts[0])[1:])
+
+    def __len__(self) -> int:
+        return self._len

@@ -156,6 +156,12 @@ class PartialShuffleMixtureSampler(ChunkedIterMixin, _TorchSampler):
                     self._pending_epoch = None
                     return arr
                 return np.asarray(self._generate_device(e))
+            if self._pending_epoch == e and self._pending is not None:
+                arr = self._pending.result()  # joins the prefetch thread
+                self._pending = None
+                self._pending_epoch = None
+                if arr is not None:  # None: forked child, thread never ran
+                    return arr
             return mixture_epoch_indices_np(
                 self.spec, self.seed, e, self.rank, self.num_replicas,
                 **self._kwargs(),
@@ -300,6 +306,18 @@ class PartialShuffleMixtureSampler(ChunkedIterMixin, _TorchSampler):
                 self._pending.copy_to_host_async()
             except AttributeError:
                 pass
+        else:
+            # host prefetch, mirroring the single-source shim: regen on a
+            # daemon thread so __iter__ finds the array ready
+            from .torch_shim import _AsyncRegen
+
+            self._pending = _AsyncRegen(
+                lambda e=e: mixture_epoch_indices_np(
+                    self.spec, self.seed, e, self.rank, self.num_replicas,
+                    **self._kwargs(),
+                )
+            )
+            self._pending_epoch = e
 
     # ------------------------------------------------------ checkpoint state
     #: §8 permutation-defining fields validated on load (the mixture

@@ -279,6 +279,119 @@ def expand_shard_indices_np(
     return out
 
 
+#: distinct-size-class cap for the one-program-per-class device path;
+#: beyond it (variable-length document corpora) shards bucket into
+#: power-of-two padded widths — O(log(size range)) compiled programs
+#: total instead of O(distinct sizes)
+_MAX_CLASS_PROGRAMS = 16
+
+
+def _rowwise_swap(xp, x, m_col, key, pair_col, rounds: int):
+    """swap-or-not over ``[0, m_col)`` with a PER-ROW traced modulus:
+    ``x`` is [R, m_b] lanes, ``m_col``/``pair_col`` are [R, 1] columns.
+    The per-round pairing constant ``K_r = mix32(pair ^ r*GOLDEN) % m``
+    is computed on the R-element column (one tiny division per row per
+    round) and broadcasts — the per-lane work stays division-free, so a
+    bucket of differently-sized shards rides one compiled program.
+    Bit-identical per row to ``core.swap_or_not`` with that row's
+    ``(m, pair_key)``; rows with ``m <= 1`` pass through (core's early
+    return)."""
+    key2 = core.mix32(xp, key ^ core._u32(xp, core._C_BIT))
+    one = core._u32(xp, 1)
+    m_ok = m_col > one
+    for r in range(rounds):
+        k_r = core.mix32(
+            xp, pair_col ^ core._u32(xp, (r * core._GOLDEN) & core._M32)
+        ) % xp.where(m_ok, m_col, one)
+        partner = k_r + (m_col - x)
+        partner = xp.where(partner >= m_col, partner - m_col, partner)
+        c = xp.where(x > partner, x, partner)
+        b = core.mix32(
+            xp, c ^ key2 ^ core._u32(xp, (r * core._RC_BIT) & core._M32)
+        )
+        x = xp.where(((b & one) == one) & m_ok, partner, x)
+    return x
+
+
+@functools.lru_cache(maxsize=None)
+def _bucket_expand_jit(m_b: int, full_like: bool, w_int: int, rounds: int,
+                       big: bool, out_pad: int):
+    """One jitted program per (power-of-two bucket width, mode, padded
+    output length): within-shard orders for R shards of VARYING sizes
+    (``n_sub`` traced; 0 marks padding rows), padded to ``m_b`` columns,
+    offset-added and SCATTERED straight into the output stream at each
+    row's traced start position (OOB-drop for pad lanes).  The scatter is
+    the point: a host-built stream-order permutation array is O(total)
+    bytes shipped host→device per epoch — measured as the dominant cost
+    of the first bucketed cut on the tunnel-attached bench device —
+    while the per-row starts are O(rows).  ``full_like`` serves both the
+    full in-shard shuffle and bounded windows covering the shard (both
+    are one inner bijection over [0, n)); the bounded mode (``w_int``
+    static) adds the windowed body + per-row tail."""
+    import jax
+    import jax.numpy as jnp
+
+    dtype = jnp.int64 if big else jnp.int32
+
+    @jax.jit
+    def f(sid_sub, n_sub, off_sub, starts_sub, seed_lo, seed_hi,
+          epoch_u32):
+        lo, hi = _shard_epoch_keys(jnp, sid_sub, (seed_lo, seed_hi))
+        ek = core.derive_epoch_key(
+            jnp, (lo[:, None], hi[:, None]), epoch_u32
+        )  # [R, 1]
+        u = jnp.arange(m_b, dtype=jnp.uint32)[None, :]  # [1, m_b]
+        n_raw = n_sub.astype(jnp.uint32)[:, None]       # [R, 1]; 0 = pad
+        n_col = jnp.maximum(n_raw, jnp.uint32(1))
+        u_c = jnp.minimum(u, n_col - jnp.uint32(1))     # pad lanes clipped
+        u_c = jnp.broadcast_to(u_c, (n_col.shape[0], m_b))
+        if full_like:
+            # W = n: nw = 1, k = 0 -> one inner bijection over [0, n)
+            kin = core.inner_key(jnp, ek, jnp.uint32(0))
+            idx = _rowwise_swap(
+                jnp, u_c, n_col, kin, core.inner_pair_key(jnp, ek), rounds
+            )
+        else:
+            # bounded window w < n (order_windows=False: windows stay put)
+            w = jnp.uint32(w_int)
+            nw_col = n_col // w                       # >= 1 (w < n)
+            body_col = nw_col * w
+            win = jnp.minimum(u_c // w, nw_col - jnp.uint32(1))
+            r0 = u_c % w
+            kin = core.inner_key(jnp, ek, win)
+            rho = core.swap_or_not(
+                jnp, r0, w_int, kin, rounds,
+                pair_key=core.inner_pair_key(jnp, ek),
+            )
+            body_idx = win * w + rho
+            tail_col = n_col - body_col               # in [0, w)
+            is_tail = u_c >= body_col
+            tpos = jnp.where(is_tail, u_c - body_col, jnp.uint32(0))
+            tpos = jnp.minimum(
+                tpos, jnp.maximum(tail_col, jnp.uint32(1)) - jnp.uint32(1)
+            )
+            rho_t = _rowwise_swap(
+                jnp, tpos, tail_col, core.tail_key(jnp, ek),
+                core.tail_key(jnp, ek), rounds,
+            )
+            idx = jnp.where(is_tail, body_col + rho_t, body_idx)
+        vals = off_sub.astype(dtype)[:, None] + idx.astype(dtype)
+        c = jnp.arange(m_b, dtype=starts_sub.dtype)[None, :]
+        tgt = jnp.where(
+            u < n_raw, starts_sub[:, None] + c,
+            jnp.asarray(out_pad, dtype=starts_sub.dtype),  # OOB -> dropped
+        )
+        return jnp.zeros((out_pad,), dtype).at[tgt.reshape(-1)].set(
+            vals.reshape(-1), mode="drop"
+        )
+
+    return f
+
+
+def _next_pow2(m: int) -> int:
+    return 1 << (int(m) - 1).bit_length()
+
+
 @functools.lru_cache(maxsize=None)
 def _class_expand_jit(m: int, full_shuffle: bool, w_int: int, rounds: int,
                       big: bool):
@@ -328,8 +441,12 @@ def expand_shard_indices_jax(
     reused across seeds and epochs (both traced).  Uniform sizes ship
     only shard ids + offsets; mixed sizes additionally ship one
     stream-order permutation per call and pay one device gather.
-    Datasets with thousands of DISTINCT shard sizes compile one program
-    per size (static shapes) — prefer the host expansion there.  Totals
+    Datasets with MANY distinct shard sizes (a variable-length document
+    corpus) do not compile one program per size: beyond
+    ``_MAX_CLASS_PROGRAMS`` distinct sizes, shards bucket into
+    power-of-two padded widths and each bucket runs one program with the
+    per-shard size TRACED (``_bucket_expand_jit``) — O(log size-range)
+    compiled programs total, ≤2x padded lanes, same values.  Totals
     >= 2^31 need ``enable_big_index_space()``.
     """
     import jax.numpy as jnp
@@ -362,6 +479,12 @@ def expand_shard_indices_jax(
     w_int = 0 if full else int(within_shard_shuffle)
     off_dtype = np.int64 if big else np.int32  # avoid silent x64 downcasts
 
+    if len(groups) > _MAX_CLASS_PROGRAMS:
+        return _expand_bucketed_jax(
+            sids, m_of, offsets, out_starts, total, full, w_int,
+            int(rounds), big, off_dtype, dtype, traced,
+        )
+
     def run_class(m, members):
         f = _class_expand_jit(m, full, w_int, int(rounds), big)
         return f(sids[members].astype(np.uint32),
@@ -388,6 +511,69 @@ def expand_shard_indices_jax(
         perm[stream_pos] = cat_pos
         base += k * m
     return cat[jnp.asarray(perm)]
+
+
+#: per-program lane budget for the bucketed device expansion (element
+#: count of the padded [R, m_b] block) — sized for HBM, not host cache;
+#: each program DISPATCH costs a fixed floor on a tunnel-attached device,
+#: so the bucketed path must run few, large programs (50 host-cache-sized
+#: slabs measured 70x the single-program uniform cost on the bench rig)
+_DEVICE_SLAB_ELEMS = 1 << 28
+
+
+def _expand_bucketed_jax(sids, m_of, offsets, out_starts, total, full,
+                         w_int, rounds, big, off_dtype, dtype, traced):
+    """The many-distinct-sizes device expansion: ONE traced-size program
+    per shuffle-mode group (``_bucket_expand_jit``), every shard padded
+    to the group's power-of-two width and the row count padded to a
+    power of two — so the compiled shapes are stable across epochs even
+    though the rank's shard draw changes — each program scattering its
+    rows straight into the (pow2-padded) output stream at per-row start
+    positions.  Host→device traffic is O(rows), never O(total): the
+    first cut shipped an O(total) stream-order permutation and measured
+    50x the uniform-size cost on the bench rig's tunnel."""
+    import jax.numpy as jnp
+
+    # a bounded window covering the shard is the same one-bijection
+    # program as the full shuffle (nw == 1); sequential (w <= 1) rides
+    # the bounded program at w=1, which is the identity per the §3 law
+    w_eff = max(w_int, 1)
+    nz = np.flatnonzero(m_of > 0)
+    # bucket key = (mode, next_pow2(size)): per-size-class pow2 buckets
+    # keep the padded-lane waste <= 2x for ANY size distribution (a
+    # single group padded to the group max would be O(max/mean) waste on
+    # a heavy-tailed corpus) while the program count stays O(log range)
+    groups: dict = {}
+    for i in nz:
+        full_like = full or int(m_of[i]) <= w_eff
+        groups.setdefault(
+            (full_like, _next_pow2(int(m_of[i]))), []
+        ).append(i)
+    out_pad = _next_pow2(max(int(total), 1))
+    acc = None
+    for full_like, m_b in sorted(groups):
+        members = np.asarray(groups[(full_like, m_b)])
+        f = _bucket_expand_jit(
+            m_b, full_like, 0 if full_like else w_eff, rounds, big,
+            out_pad,
+        )
+        max_rows = _next_pow2(max(1, _DEVICE_SLAB_ELEMS // m_b))
+        for i0 in range(0, len(members), max_rows):
+            slab = members[i0:i0 + max_rows]
+            rows = _next_pow2(len(slab))  # stable shapes across epochs
+            sid_in = np.zeros(rows, np.uint32)
+            sid_in[:len(slab)] = sids[slab]
+            n_in = np.zeros(rows, np.uint32)  # 0 marks padding rows
+            n_in[:len(slab)] = m_of[slab]
+            off_in = np.zeros(rows, off_dtype)
+            off_in[:len(slab)] = offsets[sids[slab]]
+            starts_in = np.zeros(rows, off_dtype)
+            starts_in[:len(slab)] = out_starts[slab]
+            part = f(sid_in, n_in, off_in, starts_in, *traced)
+            acc = part if acc is None else acc + part
+    if acc is None:
+        return jnp.empty(0, dtype=dtype)
+    return acc[:int(total)]
 
 
 def expand_shard_indices(

@@ -81,6 +81,44 @@ def _resolve_identity(num_replicas: Optional[int], rank: Optional[int]):
     return world, r
 
 
+class _AsyncRegen:
+    """One in-flight host regen on a daemon thread.
+
+    numpy's vectorized kernels and the ctypes call into the native C++
+    backend both release the GIL, so a ``set_epoch``-dispatched host regen
+    overlaps the consumer's compute exactly like the xla backend's async
+    device dispatch — which is what makes ``backend='auto'`` a choice
+    between two OVERLAPPED paths rather than raw costs.  Fork-safe:
+    a child process inheriting a dead thread gets ``None`` from
+    :meth:`result` and the caller regenerates synchronously."""
+
+    def __init__(self, fn) -> None:
+        import threading
+
+        self._result = None
+        self._exc: Optional[BaseException] = None
+        self._done = threading.Event()
+        self._t = threading.Thread(target=self._run, args=(fn,),
+                                   daemon=True, name="psds-regen-prefetch")
+        self._t.start()
+
+    def _run(self, fn) -> None:
+        try:
+            self._result = fn()
+        except BaseException as exc:  # surfaced at result()
+            self._exc = exc
+        finally:
+            self._done.set()
+
+    def result(self):
+        self._t.join()
+        if not self._done.is_set():
+            return None  # forked child: the thread never ran here
+        if self._exc is not None:
+            raise self._exc
+        return self._result
+
+
 def _elastic_layers_from_state(el):
     """Normalize a checkpoint's elastic field to [(world, consumed), ...].
 
@@ -244,10 +282,20 @@ class PartiallyShuffleDistributedSampler(ChunkedIterMixin, _TorchSampler):
                     self._pending_epoch = None
                 return arr
             return np.asarray(self._generate_device(e))
+        if self._pending_epoch == e and self._pending is not None:
+            arr = self._pending.result()  # joins the prefetch thread
+            if consume_prefetch:
+                self._pending = None
+                self._pending_epoch = None
+            if arr is not None:  # None: forked child, thread never ran
+                return arr
+        return self._generate_host(e)
+
+    def _generate_host(self, epoch: int) -> np.ndarray:
         from ..ops import epoch_indices_host
 
         return epoch_indices_host(
-            self.backend, self.n, self.window, self.seed, e, self.rank,
+            self.backend, self.n, self.window, self.seed, epoch, self.rank,
             self.num_replicas, shuffle=self.shuffle,
             drop_last=self.drop_last, order_windows=self.order_windows,
             partition=self.partition, rounds=self.rounds,
@@ -305,6 +353,15 @@ class PartiallyShuffleDistributedSampler(ChunkedIterMixin, _TorchSampler):
                 self._pending.copy_to_host_async()
             except AttributeError:
                 pass
+        else:
+            # the host backends prefetch too: regen on a daemon thread
+            # (GIL released inside numpy / the ctypes native call), so
+            # __iter__ finds the array ready — same overlap the device
+            # dispatch buys the xla backend
+            self._pending = _AsyncRegen(
+                lambda e=self.epoch: self._generate_host(e)
+            )
+            self._pending_epoch = self.epoch
 
     # ------------------------------------------------------ elastic reshard
     def _compute_elastic(self, layers) -> dict:

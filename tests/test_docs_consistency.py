@@ -1,0 +1,115 @@
+"""docs/API.md must match the package (round-4 verdict: the doc stated
+DEFAULT_WINDOW=8192 while the code says 4096 — a user sizing windows from
+the doc got a different permutation than documented).
+
+The gate scrapes every ``### `Name(signature)` `` heading plus the spec-
+defaults table row, imports the named symbols, and asserts each documented
+``kwarg=default`` against ``inspect.signature``.  If API.md and the code
+diverge again, this file fails.
+"""
+
+import ast
+import inspect
+import re
+from pathlib import Path
+
+import pytest
+
+API_MD = Path(__file__).resolve().parent.parent / "docs" / "API.md"
+
+#: where the heading-documented classes/functions live
+_NAMESPACES = (
+    "partiallyshuffledistributedsampler_tpu",
+    "partiallyshuffledistributedsampler_tpu.sampler",
+    "partiallyshuffledistributedsampler_tpu.ops",
+    "partiallyshuffledistributedsampler_tpu.ops.cpu",
+)
+
+
+def _resolve(name: str):
+    import importlib
+
+    for ns in _NAMESPACES:
+        mod = importlib.import_module(ns)
+        if hasattr(mod, name):
+            return getattr(mod, name)
+    raise AssertionError(f"API.md documents {name!r}, not importable from "
+                         f"any of {_NAMESPACES}")
+
+
+def _split_args(argstr: str):
+    """Top-level comma split (the documented signatures nest no parens)."""
+    return [a.strip() for a in argstr.split(",") if a.strip()]
+
+
+def _documented_signatures():
+    text = API_MD.read_text()
+    # the ###-heading signatures
+    for m in re.finditer(r"^### `(\w+)\((.*)\)`\s*$", text, re.M):
+        yield m.group(1), m.group(2)
+    # the top-table reference-implementation row
+    m = re.search(r"`epoch_indices_np\(([^`]*)\)`", text)
+    assert m, "API.md lost the epoch_indices_np row"
+    yield "epoch_indices_np", m.group(1)
+
+
+def _doc_defaults(argstr: str):
+    out = {}
+    for tok in _split_args(argstr):
+        if tok.startswith("*") or tok in ("...",) or "=" not in tok:
+            continue
+        k, v = tok.split("=", 1)
+        try:
+            out[k.strip()] = ast.literal_eval(v.strip())
+        except (ValueError, SyntaxError):
+            continue  # prose placeholders like ...same...
+    return out
+
+
+@pytest.mark.parametrize("name,argstr", list(_documented_signatures()))
+def test_documented_signature_matches_code(name, argstr):
+    obj = _resolve(name)
+    fn = obj.__init__ if inspect.isclass(obj) else obj
+    sig = inspect.signature(fn)
+    params = sig.parameters
+    for k, doc_default in _doc_defaults(argstr).items():
+        assert k in params, (
+            f"API.md documents {name}(... {k}=...) but the signature has "
+            f"no such parameter: {sig}"
+        )
+        actual = params[k].default
+        assert actual is not inspect.Parameter.empty, (
+            f"API.md gives {name}.{k} a default {doc_default!r}; the code "
+            "has none"
+        )
+        assert actual == doc_default, (
+            f"API.md says {name}(... {k}={doc_default!r} ...) but the code "
+            f"default is {actual!r}"
+        )
+    # every documented bare (non-defaulted, non-star) name must exist too
+    for tok in _split_args(argstr):
+        if tok.startswith("*") or "=" in tok or not tok.isidentifier():
+            continue
+        assert tok in params, (
+            f"API.md documents {name}(... {tok} ...) not in {sig}"
+        )
+
+
+def test_spec_defaults_row_matches_constants():
+    import partiallyshuffledistributedsampler_tpu as psds
+
+    text = API_MD.read_text()
+    m = re.search(
+        r"`DEFAULT_WINDOW`, `DEFAULT_ROUNDS` \| spec defaults "
+        r"\((\d+), (\d+)\)", text,
+    )
+    assert m, "API.md lost the spec-defaults row"
+    assert int(m.group(1)) == psds.DEFAULT_WINDOW
+    assert int(m.group(2)) == psds.DEFAULT_ROUNDS
+
+
+def test_mixture_iterator_windows_documented_behavior():
+    """The API.md claim 'reading window raises' is itself load-bearing —
+    pin it here next to the signature checks."""
+    text = API_MD.read_text()
+    assert "`windows` (property)" in text and "`window` raises" in text

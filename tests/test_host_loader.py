@@ -123,7 +123,7 @@ def test_early_break_retires_prefetch_thread():
 
 def test_gather_error_surfaces_to_consumer():
     class Bad(HostDataLoader):
-        def epoch_indices(self, epoch):
+        def epoch_indices(self, epoch, layers=None):
             return np.full(self.num_samples, N + 999)  # out of bounds
 
     loader = Bad({"x": np.arange(N)}, window=WINDOW, batch=BATCH, world=WORLD)
@@ -144,3 +144,147 @@ def test_validation_errors():
         next(make().epoch(0, start_step=999))
     with pytest.raises(ValueError, match="at least one"):
         HostDataLoader({}, window=8, batch=4)
+
+
+# ------------------------------------------------- round-5 stream tiers
+def test_mixture_loader_concatenated_matches_sampler():
+    """mixture=spec over ONE concatenated pytree: batches must be the §8
+    stream gathered from the concatenated id space, bit-equal to
+    mixture_epoch_indices_np cut into batch slices."""
+    from partiallyshuffledistributedsampler_tpu.ops.mixture import (
+        MixtureSpec, mixture_epoch_indices_np,
+    )
+
+    spec = MixtureSpec([200, 100, 300], [3, 1, 2], windows=16, block=30)
+    total = spec.total_sources_len
+    X = np.arange(total * 2).reshape(total, 2)
+    loader = HostDataLoader({"x": X}, batch=32, world=2, rank=1,
+                            mixture=spec, window=None)
+    ref = mixture_epoch_indices_np(spec, 0, 4, 1, 2)
+    got = list(loader.epoch(4))
+    whole = len(ref) // 32
+    assert len(got) == whole == loader.steps_per_epoch
+    for s, b in enumerate(got):
+        assert np.array_equal(np.asarray(b["x"]), X[ref[s*32:(s+1)*32]])
+
+
+def test_mixture_loader_per_source_data_matches_concatenated():
+    """The per-source data form (one pytree per corpus, gathered via
+    spec.decompose) must serve the SAME batches as the concatenated
+    form — the C4 multi-corpus shape never concatenates on the host."""
+    from partiallyshuffledistributedsampler_tpu.ops.mixture import (
+        MixtureSpec,
+    )
+
+    spec = MixtureSpec([200, 100, 300], [3, 1, 2], windows=16, block=30)
+    total = spec.total_sources_len
+    X = np.arange(total * 2).reshape(total, 2)
+    parts = np.split(X, np.cumsum(spec.sources)[:-1])
+    cat = HostDataLoader({"x": X}, batch=32, world=2, rank=0, mixture=spec)
+    per = HostDataLoader([{"x": p} for p in parts], batch=32, world=2,
+                         rank=0, mixture=spec)
+    for a, b in zip(cat.epoch(1), per.epoch(1)):
+        assert np.array_equal(np.asarray(a["x"]), np.asarray(b["x"]))
+    # bare per-source arrays serve unwrapped batches
+    bare = HostDataLoader([p for p in parts], batch=32, world=2, rank=0,
+                          mixture=spec)
+    for a, b in zip(cat.epoch(2), bare.epoch(2)):
+        assert np.array_equal(np.asarray(a["x"]), np.asarray(b))
+
+
+def test_mixture_loader_epoch_samples_and_validation():
+    from partiallyshuffledistributedsampler_tpu.ops.mixture import (
+        MixtureSpec, mixture_epoch_indices_np,
+    )
+
+    spec = MixtureSpec([200, 100], [1, 1], windows=16, block=10)
+    X = np.arange(300)
+    loader = HostDataLoader(X, batch=25, mixture=spec, epoch_samples=700)
+    ref = mixture_epoch_indices_np(spec, 0, 0, 0, 1, epoch_samples=700)
+    got = np.concatenate([np.asarray(b) for b in loader.epoch(0)])
+    assert np.array_equal(got, X[ref[:len(got)]])
+    with pytest.raises(ValueError, match="window"):
+        HostDataLoader(X, batch=25, mixture=spec, window=64)
+    with pytest.raises(ValueError, match="native"):
+        HostDataLoader(X, batch=25, mixture=spec, index_backend="native")
+    with pytest.raises(ValueError, match="sources sum"):
+        HostDataLoader(np.arange(299), batch=25, mixture=spec)
+    with pytest.raises(ValueError, match="epoch_samples"):
+        HostDataLoader(X, batch=25, window=16, epoch_samples=5)
+    with pytest.raises(TypeError, match="MixtureSpec"):
+        HostDataLoader(X, batch=25, mixture=[200, 100])
+
+
+def test_shard_mode_loader_matches_expansion():
+    """shard_sizes=[...]: the loader serves the rank's shard stream
+    EXPANDED to sample indices (SPEC.md §7), bit-equal to
+    expand_shard_indices_np over epoch_indices_np(num_shards, ...)."""
+    from partiallyshuffledistributedsampler_tpu.sampler.shard_mode import (
+        expand_shard_indices_np,
+    )
+
+    rng = np.random.default_rng(3)
+    sizes = rng.integers(8, 20, 40)
+    total = int(sizes.sum())
+    X = np.arange(total)
+    loader = HostDataLoader(X, batch=16, world=2, rank=1, window=8,
+                            shard_sizes=sizes, seed=5)
+    assert loader.steps_per_epoch is None  # per-epoch, by design
+    sid = epoch_indices_np(40, 8, 5, 2, 1, 2)
+    ref = expand_shard_indices_np(sid, sizes, seed=5, epoch=2)
+    steps = loader.epoch_steps(2)
+    assert steps == len(ref) // 16
+    got = np.concatenate([np.asarray(b) for b in loader.epoch(2)])
+    assert np.array_equal(got, X[ref[:steps * 16]])
+    # resume mid-epoch
+    got3 = np.concatenate([np.asarray(b)
+                           for b in loader.epoch(2, start_step=3)])
+    assert np.array_equal(got3, X[ref[3 * 16:steps * 16]])
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        from partiallyshuffledistributedsampler_tpu.ops.mixture import (
+            MixtureSpec,
+        )
+        HostDataLoader(X, batch=16, shard_sizes=sizes,
+                       mixture=MixtureSpec([total], [1]))
+
+
+def test_elastic_layers_epoch_matches_reference():
+    """epoch(e, layers=...): the §6 remainder stream through the loader,
+    for the single-source AND mixture tiers, bit-equal to the elastic
+    reference frontends."""
+    from partiallyshuffledistributedsampler_tpu.ops.cpu import (
+        elastic_indices_np,
+    )
+    from partiallyshuffledistributedsampler_tpu.ops.mixture import (
+        MixtureSpec, mixture_elastic_indices_np,
+    )
+
+    X = np.arange(N)
+    loader = make({"x": X})
+    layers = [(3, 40)]
+    ref = elastic_indices_np(N, WINDOW, 0, 1, 0, WORLD, layers)
+    got = np.concatenate([np.asarray(b["x"])
+                          for b in loader.epoch(1, layers=layers)])
+    whole = (len(ref) // BATCH) * BATCH
+    assert np.array_equal(got, X[ref[:whole]])
+    spec = MixtureSpec([200, 100, 300], [3, 1, 2], windows=16, block=30)
+    MX = np.arange(spec.total_sources_len)
+    mloader = HostDataLoader(MX, batch=32, world=2, rank=0, mixture=spec)
+    mref = mixture_elastic_indices_np(spec, 0, 1, 0, 2, layers)
+    mgot = np.concatenate([np.asarray(b)
+                           for b in mloader.epoch(1, layers=layers)])
+    assert np.array_equal(mgot, MX[mref[:(len(mref) // 32) * 32]])
+
+
+def test_mixture_loader_xla_backend_matches_cpu():
+    from partiallyshuffledistributedsampler_tpu.ops.mixture import (
+        MixtureSpec,
+    )
+
+    spec = MixtureSpec([200, 100, 300], [3, 1, 2], windows=16, block=30)
+    X = np.arange(spec.total_sources_len)
+    a = HostDataLoader(X, batch=32, world=2, rank=1, mixture=spec)
+    b = HostDataLoader(X, batch=32, world=2, rank=1, mixture=spec,
+                       index_backend="xla")
+    for ba, bb in zip(a.epoch(3), b.epoch(3)):
+        assert np.array_equal(np.asarray(ba), np.asarray(bb))

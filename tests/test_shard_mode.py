@@ -291,3 +291,49 @@ def test_shard_sampler_elastic_reshard():
 
     assert_exactly_once(consumed_ids, remainder_ids, stream, old_world,
                         consumed, "strided", new_world)
+
+
+# ------------------------------------------------ round-5 bucketed device path
+def test_bucketed_device_expansion_bit_identical():
+    """A variable-length corpus (hundreds of DISTINCT shard sizes, well
+    past _MAX_CLASS_PROGRAMS) must expand on device through the
+    power-of-two bucketed programs — bit-identical to the host expansion
+    across every shuffle mode, including zero-size and size-1 shards."""
+    from partiallyshuffledistributedsampler_tpu.sampler.shard_mode import (
+        _MAX_CLASS_PROGRAMS, expand_shard_indices_jax,
+    )
+
+    rng = np.random.default_rng(7)
+    sizes = np.concatenate([
+        rng.integers(1, 400, 300), [0, 0, 1, 1, 2],
+        rng.integers(200, 2000, 200),
+    ])
+    sid_stream = rng.permutation(len(sizes))[:400]
+    assert len(set(int(s) for s in sizes[sid_stream])) > _MAX_CLASS_PROGRAMS
+    for wss in (True, False, 0, 3, 64, 5000):
+        a = expand_shard_indices_np(sid_stream, sizes, seed=5, epoch=2,
+                                    within_shard_shuffle=wss)
+        b = np.asarray(expand_shard_indices_jax(
+            sid_stream, sizes, seed=5, epoch=2, within_shard_shuffle=wss))
+        assert np.array_equal(a, b), wss
+
+
+def test_bucketed_compile_count_is_bounded():
+    """The bucketed path must compile O(log size-range) programs, not
+    O(distinct sizes): two corpora with disjoint size sets but the same
+    power-of-two buckets share every cached executable."""
+    from partiallyshuffledistributedsampler_tpu.sampler.shard_mode import (
+        _bucket_expand_jit, expand_shard_indices_jax,
+    )
+
+    rng = np.random.default_rng(1)
+    sizes_a = rng.integers(100, 1000, 64) * 2      # even sizes
+    sizes_b = rng.integers(100, 1000, 64) * 2 + 1  # odd sizes (disjoint)
+    sid = np.arange(64)
+    np.asarray(expand_shard_indices_jax(sid, sizes_a, seed=1, epoch=0))
+    info = _bucket_expand_jit.cache_info()
+    np.asarray(expand_shard_indices_jax(sid, sizes_b, seed=1, epoch=0))
+    info2 = _bucket_expand_jit.cache_info()
+    # same pow2 buckets -> zero NEW compiled programs for the second corpus
+    assert info2.currsize == info.currsize
+    assert info2.hits > info.hits
