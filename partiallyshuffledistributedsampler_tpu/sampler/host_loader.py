@@ -221,10 +221,7 @@ class HostDataLoader:
             # the per-epoch SAMPLE count follows the rank's shard draw
             self.steps_per_epoch: Optional[int] = None
         else:
-            if drop_last_batch:
-                self.steps_per_epoch = self.num_samples // self.batch
-            else:
-                self.steps_per_epoch = -(-self.num_samples // self.batch)
+            self.steps_per_epoch = self._steps_for(self.num_samples)
             if self.steps_per_epoch == 0:
                 raise ValueError(
                     f"batch={batch} exceeds the rank's "
@@ -261,6 +258,25 @@ class HostDataLoader:
                         f"{int(np.shape(v)[0])} rows; spec says "
                         f"{spec.sources[i]}"
                     )
+                # the gather buffer takes source 0's dtype/trailing shape:
+                # a mismatched source would silently wrap values into it
+                # (int64 ids into an int32 buffer) or fail mid-epoch in
+                # the producer thread — refuse at construction instead
+                ref = per_source[0][k]
+                v_dt = np.asarray(v[:0]).dtype
+                r_dt = np.asarray(ref[:0]).dtype
+                if v_dt != r_dt:
+                    raise ValueError(
+                        f"source {i} array {k!r} has dtype {v_dt}; "
+                        f"source 0 has {r_dt} — batches gather into one "
+                        "buffer, so per-source dtypes must match"
+                    )
+                if tuple(np.shape(v)[1:]) != tuple(np.shape(ref)[1:]):
+                    raise ValueError(
+                        f"source {i} array {k!r} has trailing shape "
+                        f"{tuple(np.shape(v)[1:])}; source 0 has "
+                        f"{tuple(np.shape(ref)[1:])}"
+                    )
         # a zero-copy stand-in dict keyed like the sources: the loader's
         # generic plumbing only reads its keys and (summed) length
         proto = {
@@ -286,6 +302,7 @@ class HostDataLoader:
         if cached is not None and cached[0] == key:
             return cached[1]
         idx = self._compute_epoch_indices(epoch, layers)
+        idx.setflags(write=False)  # shared between epoch_steps and epoch
         self._idx_cache = (key, idx)
         return idx
 

@@ -314,28 +314,59 @@ def _rowwise_swap(xp, x, m_col, key, pair_col, rounds: int):
 
 
 @functools.lru_cache(maxsize=None)
-def _bucket_expand_jit(m_b: int, full_like: bool, w_int: int, rounds: int,
-                       big: bool, out_pad: int):
-    """One jitted program per (power-of-two bucket width, mode, padded
-    output length): within-shard orders for R shards of VARYING sizes
-    (``n_sub`` traced; 0 marks padding rows), padded to ``m_b`` columns,
-    offset-added and SCATTERED straight into the output stream at each
-    row's traced start position (OOB-drop for pad lanes).  The scatter is
-    the point: a host-built stream-order permutation array is O(total)
-    bytes shipped host→device per epoch — measured as the dominant cost
-    of the first bucketed cut on the tunnel-attached bench device —
-    while the per-row starts are O(rows).  ``full_like`` serves both the
-    full in-shard shuffle and bounded windows covering the shard (both
-    are one inner bijection over [0, n)); the bounded mode (``w_int``
-    static) adds the windowed body + per-row tail."""
+def _bucket_scatter_jit(out_pad: int, m_b: int, big: bool):
+    """The (cheap to compile) scatter stage: padded bucket values [R, m_b]
+    land in the output stream at per-row traced start positions, pad
+    lanes OOB-dropped.  Split from the bijection program deliberately:
+    ``out_pad`` tracks the rank's per-epoch total and can flip across a
+    power-of-two boundary between epochs — that must invalidate only
+    this trivial program, never the 24-round-unrolled bucket bijections.
+
+    The scatter itself is the point of the design: a host-built
+    stream-order permutation array is O(total) bytes shipped host→device
+    per epoch — measured as the dominant cost of the first bucketed cut
+    on the tunnel-attached bench device — while the per-row starts are
+    O(rows)."""
     import jax
     import jax.numpy as jnp
 
     dtype = jnp.int64 if big else jnp.int32
 
     @jax.jit
-    def f(sid_sub, n_sub, off_sub, starts_sub, seed_lo, seed_hi,
-          epoch_u32):
+    def f(vals, n_sub, starts_sub):
+        c = jnp.arange(m_b, dtype=starts_sub.dtype)[None, :]
+        valid = jnp.arange(m_b, dtype=jnp.uint32)[None, :] \
+            < n_sub.astype(jnp.uint32)[:, None]
+        tgt = jnp.where(
+            valid, starts_sub[:, None] + c,
+            jnp.asarray(out_pad, dtype=starts_sub.dtype),  # OOB -> dropped
+        )
+        return jnp.zeros((out_pad,), dtype).at[tgt.reshape(-1)].set(
+            vals.reshape(-1), mode="drop"
+        )
+
+    return f
+
+
+@functools.lru_cache(maxsize=None)
+def _bucket_expand_jit(m_b: int, full_like: bool, w_int: int, rounds: int,
+                       big: bool):
+    """One jitted program per (power-of-two bucket width, mode): within-
+    shard orders for R shards of VARYING sizes (``n_sub`` traced; 0
+    marks padding rows), padded to ``m_b`` columns, plus the global
+    offset add.  ``full_like`` serves both the full in-shard shuffle and
+    bounded windows covering the shard (both are one inner bijection
+    over [0, n)); the bounded mode (``w_int`` static) adds the windowed
+    body + per-row tail.  The stream-order scatter is a separate program
+    (``_bucket_scatter_jit``) so epoch-varying output lengths never
+    recompile these."""
+    import jax
+    import jax.numpy as jnp
+
+    dtype = jnp.int64 if big else jnp.int32
+
+    @jax.jit
+    def f(sid_sub, n_sub, off_sub, seed_lo, seed_hi, epoch_u32):
         lo, hi = _shard_epoch_keys(jnp, sid_sub, (seed_lo, seed_hi))
         ek = core.derive_epoch_key(
             jnp, (lo[:, None], hi[:, None]), epoch_u32
@@ -375,15 +406,7 @@ def _bucket_expand_jit(m_b: int, full_like: bool, w_int: int, rounds: int,
                 core.tail_key(jnp, ek), rounds,
             )
             idx = jnp.where(is_tail, body_col + rho_t, body_idx)
-        vals = off_sub.astype(dtype)[:, None] + idx.astype(dtype)
-        c = jnp.arange(m_b, dtype=starts_sub.dtype)[None, :]
-        tgt = jnp.where(
-            u < n_raw, starts_sub[:, None] + c,
-            jnp.asarray(out_pad, dtype=starts_sub.dtype),  # OOB -> dropped
-        )
-        return jnp.zeros((out_pad,), dtype).at[tgt.reshape(-1)].set(
-            vals.reshape(-1), mode="drop"
-        )
+        return off_sub.astype(dtype)[:, None] + idx.astype(dtype)
 
     return f
 
@@ -554,9 +577,9 @@ def _expand_bucketed_jax(sids, m_of, offsets, out_starts, total, full,
     for full_like, m_b in sorted(groups):
         members = np.asarray(groups[(full_like, m_b)])
         f = _bucket_expand_jit(
-            m_b, full_like, 0 if full_like else w_eff, rounds, big,
-            out_pad,
+            m_b, full_like, 0 if full_like else w_eff, rounds, big
         )
+        scat = _bucket_scatter_jit(out_pad, m_b, big)
         max_rows = _next_pow2(max(1, _DEVICE_SLAB_ELEMS // m_b))
         for i0 in range(0, len(members), max_rows):
             slab = members[i0:i0 + max_rows]
@@ -569,7 +592,7 @@ def _expand_bucketed_jax(sids, m_of, offsets, out_starts, total, full,
             off_in[:len(slab)] = offsets[sids[slab]]
             starts_in = np.zeros(rows, off_dtype)
             starts_in[:len(slab)] = out_starts[slab]
-            part = f(sid_in, n_in, off_in, starts_in, *traced)
+            part = scat(f(sid_in, n_in, off_in, *traced), n_in, starts_in)
             acc = part if acc is None else acc + part
     if acc is None:
         return jnp.empty(0, dtype=dtype)
