@@ -334,8 +334,8 @@ _SELECT_CAP = 8
 
 #: lane-count cap for the [B, B] packed rotation table (one 4 MB-table
 #: gather); beyond it the two-tiny-table variant wins (measured on the
-#: bench device: 31M lanes — packed 297 ms vs tiny 705; 125M lanes —
-#: packed 3142 vs tiny 2607: the big table's cache behavior inverts
+#: bench device: 31M lanes — packed 297 ms vs chained 705; 125M lanes —
+#: packed 3142 vs tiny 1814: the big table's cache behavior inverts
 #: between those, so the cap sits at 64M)
 _ROT_PACK_LANES_CAP = 1 << 26
 

@@ -892,3 +892,25 @@ def test_sampler_accepts_sized_datasets():
     s2 = make_sampler()
     s.set_epoch(1), s2.set_epoch(1)
     assert list(s) == list(s2)
+
+
+def test_fused_gather_strategies_bit_identical(monkeypatch):
+    """All three lane-parameter strategies of the fused evaluator — the
+    [B, B] packed rotation table, the two-tiny-table variant (forced here
+    by shrinking the lane cap), and the chained-gather fallback (forced
+    by oversizing the block cap) — must produce the identical stream."""
+    spec = make_spec()
+    pos = np.arange(5000)
+    ref = M.mixture_stream_at_np(pos, spec, 9, 4, fused=False)
+    packed = M.mixture_stream_at_np(pos, spec, 9, 4)
+    assert np.array_equal(ref, packed)
+    monkeypatch.setattr(M, "_ROT_PACK_LANES_CAP", 1)  # force two-tiny
+    tiny = M.mixture_stream_at_np(pos, spec, 9, 4)
+    assert np.array_equal(ref, tiny)
+    # chained fallback: block too large for any packed table
+    monkeypatch.setattr(M.MixtureSpec, "_PACK_B_CAP", 1)
+    spec2 = make_spec()  # fresh spec: no cached packed tables
+    chained = M.mixture_stream_at_np(pos, spec2, 9, 4)
+    ref2 = M.mixture_stream_at_np(pos, spec2, 9, 4, fused=False)
+    assert np.array_equal(ref2, chained)
+    assert np.array_equal(ref, chained)  # same spec params, same stream
