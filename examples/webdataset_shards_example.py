@@ -110,3 +110,24 @@ if __name__ == "__main__":
     np.testing.assert_array_equal(np.asarray(dev), host)
     print(f"device expansion: rank 0 epoch 0 -> {len(host)} indices in HBM,"
           " bit-identical to the host expansion")
+
+    # variable-length document shards (hundreds of DISTINCT sizes — the
+    # case that used to force the host path): past 16 distinct sizes the
+    # device expansion buckets shards into pow2-padded traced-size
+    # programs and scatters straight into the stream, so a variable-size
+    # corpus expands on device too, bit-identically
+    from partiallyshuffledistributedsampler_tpu.sampler import (
+        expand_shard_indices_jax,
+    )
+
+    rng = np.random.default_rng(5)
+    var_sizes = rng.integers(20, 400, 800)
+    var_stream = rng.permutation(800)[:300]
+    vdev = np.asarray(expand_shard_indices_jax(
+        var_stream, var_sizes, seed=11, epoch=0))
+    vhost = expand_shard_indices_np(
+        var_stream, var_sizes, seed=11, epoch=0)
+    np.testing.assert_array_equal(vdev, vhost)
+    print(f"variable-size expansion: {len(set(var_sizes.tolist()))} "
+          f"distinct shard sizes -> {len(vhost)} indices on device, "
+          "bit-identical (bucketed pow2 programs)")

@@ -415,3 +415,33 @@ def test_mixture_elastic_reshard_law(cfg, frac, new_world):
             np, q, V, ns_V, consumed, cfg["partition"], np.uint32)
         ref = M.mixture_stream_at_np(pos, spec, cfg["seed"], cfg["epoch"])
         assert np.array_equal(got, ref)
+
+
+@settings(max_examples=50, **SETTINGS)
+@given(cfg=MIX_CONFIGS, pv=st.integers(1, 2))
+def test_mixture_fused_equals_masked_random_configs(cfg, pv):
+    """The fused per-lane evaluator must equal the masked per-source
+    reference over RANDOM mixture configs and both pattern versions —
+    fuzzing the branch space (packed/two-tiny/chained lane parameters,
+    tails, multi-pass sources, tiny windows, rotation wrap) that the
+    fixed-case parity tests enumerate by hand."""
+    from partiallyshuffledistributedsampler_tpu.ops import mixture as M
+
+    spec = _mix_spec(cfg)
+    if spec is None:
+        return
+    if pv == 1:
+        spec = M.MixtureSpec(spec.sources, spec.weights,
+                             windows=list(spec.windows), block=spec.block,
+                             pattern_version=1)
+    rng = np.random.default_rng(cfg["weights_seed"] ^ 0xA5)
+    pos = np.concatenate([
+        np.arange(min(300, sum(spec.sources))),
+        rng.integers(0, 4 * sum(spec.sources) + 1, 100),
+    ])
+    a = M.mixture_stream_at_generic(np, pos, spec, cfg["seed"],
+                                    cfg["epoch"], fused=False,
+                                    amortize=False)
+    b = M.mixture_stream_at_generic(np, pos, spec, cfg["seed"],
+                                    cfg["epoch"], fused=True)
+    assert np.array_equal(a, b)
