@@ -11,6 +11,7 @@
 // ctypes by ops/native.py; absence is never an error (numpy fallback).
 
 #include <cstdint>
+#include <vector>
 
 namespace {
 
@@ -144,6 +145,141 @@ int epoch_indices_impl(uint64_t n, uint32_t window, uint32_t seed_lo,
   return 0;
 }
 
+// ---------------------------------------------------------------------------
+// SPEC.md §8: the weighted mixture stream (v1 and v2 pattern laws).
+// Mirrors ops/mixture.py bit-for-bit; cross-checked by tests/test_native.py.
+// ---------------------------------------------------------------------------
+
+constexpr uint64_t MIX_SEED_STRIDE = 0xB5297A4D2C7E9FD3ull;
+constexpr uint32_t C_PASS = 0x632BE5ABu;
+constexpr uint32_t C_ROT = 0x6A09E667u;
+
+// Per-source state: §8.3 seeds/keys plus the pairing schedules (all from
+// the pass-FREE key ek0, per the spec's split key schedule) and the
+// per-(pass, window) decision-key caches — consecutive draws of a source
+// walk the same pass and usually the same window, so the amortization
+// mirrors epoch_indices_impl's cached_j trick.
+struct MixSrc {
+  uint64_t n, body, base;
+  uint32_t W, nw, tail;
+  uint32_t lo, hi;
+  bool do_outer;
+  SonSchedule outer_pair, inner_pair, tail_pair;
+  uint64_t cur_pas;
+  uint32_t ek, okey2, tkey2;
+  uint64_t cached_win;
+  uint32_t cached_k, cached_inner_key2;
+};
+
+template <typename OutT>
+int mixture_indices_impl(uint32_t S, const uint64_t *sources,
+                         const uint32_t *windows, const int32_t *pattern,
+                         const int64_t *prefix, const uint64_t *quotas,
+                         uint32_t B, int rotated, uint32_t seed_lo,
+                         uint32_t seed_hi, uint32_t epoch, uint64_t rank,
+                         uint64_t world, int shuffle, int order_windows,
+                         int strided, uint32_t rounds, uint64_t num_samples,
+                         OutT *out) {
+  if (S == 0 || world == 0 || rank >= world || B == 0) return -1;
+  if (rounds > 64) return -2;
+  std::vector<MixSrc> src(S);
+  uint64_t base = 0;
+  for (uint32_t s = 0; s < S; ++s) {
+    MixSrc &st = src[s];
+    st.n = sources[s];
+    st.W = windows[s];
+    if (st.n == 0 || st.W == 0 || st.W > st.n) return -1;
+    if (st.W > 0x7FFFFFFFu) return -3;
+    const uint64_t nw64 = st.n / st.W;
+    if (nw64 > 0x7FFFFFFFull) return -3;
+    st.nw = (uint32_t)nw64;
+    st.body = nw64 * st.W;
+    st.tail = (uint32_t)(st.n - st.body);
+    st.base = base;
+    base += st.n;
+    const uint64_t d = MIX_SEED_STRIDE + s;  // 64-bit wrap, as in python
+    st.lo = seed_lo ^ (uint32_t)d;
+    st.hi = seed_hi ^ (uint32_t)(d >> 32);
+    const uint32_t ek0 = derive_epoch_key(st.lo, st.hi, epoch);
+    st.do_outer = order_windows && st.nw > 1;
+    if (st.do_outer)
+      make_schedule(st.outer_pair, st.nw, mix32(ek0 ^ C_OUTER), rounds);
+    if (st.W > 1)
+      make_schedule(st.inner_pair, st.W, mix32(ek0 ^ C_PAIR), rounds);
+    if (st.tail > 1)
+      make_schedule(st.tail_pair, st.tail, mix32(ek0 ^ C_TAIL), rounds);
+    st.cur_pas = ~0ull;
+    st.cached_win = ~0ull;
+  }
+  const uint32_t rk =
+      rotated ? mix32(derive_epoch_key(seed_lo, seed_hi, epoch) ^ C_ROT) : 0;
+
+  for (uint64_t i = 0; i < num_samples; ++i) {
+    // §8.4 positions are NOT wrapped: the stream is total
+    const uint64_t p = strided ? rank + world * i : rank * num_samples + i;
+    const uint32_t t = (uint32_t)(p % B);
+    const uint64_t blk = p / B;
+    uint32_t slot = t;
+    int64_t cnt;
+    uint32_t s_id;
+    if (rotated) {
+      // §8.2a: rotation keys on blk mod 2^32, like the vectorized paths
+      const uint32_t r = mix32(rk ^ (uint32_t)blk) % B;
+      const uint32_t a = t + r;
+      const bool wrap = a >= B;
+      slot = wrap ? a - B : a;
+      s_id = (uint32_t)pattern[slot];
+      cnt = prefix[(uint64_t)slot * S + s_id] -
+            prefix[(uint64_t)r * S + s_id] +
+            (wrap ? (int64_t)quotas[s_id] : 0);
+    } else {
+      s_id = (uint32_t)pattern[slot];
+      cnt = prefix[(uint64_t)slot * S + s_id];
+    }
+    MixSrc &st = src[s_id];
+    const uint64_t j = blk * quotas[s_id] + (uint64_t)cnt;
+    const uint64_t pas = j / st.n;
+    const uint64_t u = j % st.n;
+    uint64_t idx;
+    if (!shuffle) {
+      idx = u;
+    } else {
+      if (pas != st.cur_pas) {
+        st.cur_pas = pas;
+        // §8.3 pass-folded epoch; pas truncates to uint32 like the
+        // vectorized paths' .astype(uint32)
+        const uint32_t ep_u = mix32(epoch ^ mix32((uint32_t)pas ^ C_PASS));
+        st.ek = derive_epoch_key(st.lo, st.hi, ep_u);
+        st.okey2 = mix32(mix32(st.ek ^ C_OUTER) ^ C_BIT);
+        st.tkey2 = mix32(mix32(st.ek ^ C_TAIL) ^ C_BIT);
+        st.cached_win = ~0ull;
+      }
+      if (u < st.body) {
+        const uint64_t win = u / st.W;
+        const uint32_t r0 = (uint32_t)(u % st.W);
+        if (win != st.cached_win) {
+          st.cached_win = win;
+          st.cached_k = st.do_outer ? son_apply(st.outer_pair, (uint32_t)win,
+                                                st.okey2)
+                                    : (uint32_t)win;
+          const uint32_t kin =
+              mix32(st.ek ^ C_INNER ^ mix32(st.cached_k ^ C_WIN));
+          st.cached_inner_key2 = mix32(kin ^ C_BIT);
+        }
+        idx = (uint64_t)st.cached_k * st.W +
+              (st.W > 1 ? son_apply(st.inner_pair, r0, st.cached_inner_key2)
+                        : 0u);
+      } else {
+        const uint32_t tpos = (uint32_t)(u - st.body);
+        idx = st.body +
+              (st.tail > 1 ? son_apply(st.tail_pair, tpos, st.tkey2) : tpos);
+      }
+    }
+    out[i] = (OutT)(st.base + idx);
+  }
+  return 0;
+}
+
 } // namespace
 
 extern "C" {
@@ -170,6 +306,37 @@ int psds_epoch_indices(uint64_t n, uint32_t window, uint32_t seed_lo,
                                        rank, world, shuffle, order_windows,
                                        strided, rounds, num_samples,
                                        (int64_t *)out);
+  return -5;
+}
+
+// Fills out[0..num_samples) with rank's §8 mixture-epoch GLOBAL ids.
+// pattern is the spec's [B] int32 table, prefix the [B, S] row-major int64
+// prefix-count table, quotas/sources/windows the per-source vectors (the
+// caller passes the spec's own capped windows).  rotated selects the
+// §8.2a v2 per-block rotation (pattern_version >= 2 and shuffle).
+// out_width as in psds_epoch_indices (4 requires sum(sources) <= 2^31-1).
+int psds_mixture_indices(uint32_t S, const uint64_t *sources,
+                         const uint32_t *windows, const int32_t *pattern,
+                         const int64_t *prefix, const uint64_t *quotas,
+                         uint32_t B, int rotated, uint32_t seed_lo,
+                         uint32_t seed_hi, uint32_t epoch, uint64_t rank,
+                         uint64_t world, int shuffle, int order_windows,
+                         int strided, uint32_t rounds, uint64_t num_samples,
+                         int out_width, void *out) {
+  if (out_width == 4) {
+    uint64_t total = 0;
+    for (uint32_t s = 0; s < S; ++s) total += sources[s];
+    if (total > 0x7FFFFFFFull) return -4;
+    return mixture_indices_impl<int32_t>(
+        S, sources, windows, pattern, prefix, quotas, B, rotated, seed_lo,
+        seed_hi, epoch, rank, world, shuffle, order_windows, strided, rounds,
+        num_samples, (int32_t *)out);
+  }
+  if (out_width == 8)
+    return mixture_indices_impl<int64_t>(
+        S, sources, windows, pattern, prefix, quotas, B, rotated, seed_lo,
+        seed_hi, epoch, rank, world, shuffle, order_windows, strided, rounds,
+        num_samples, (int64_t *)out);
   return -5;
 }
 

@@ -74,6 +74,16 @@ def _load() -> ctypes.CDLL:
             ctypes.c_uint32, ctypes.c_uint64, ctypes.c_int,
             ctypes.c_void_p,
         ]
+        lib.psds_mixture_indices.restype = ctypes.c_int
+        lib.psds_mixture_indices.argtypes = [
+            ctypes.c_uint32, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_uint32, ctypes.c_int, ctypes.c_uint32,
+            ctypes.c_uint32, ctypes.c_uint32, ctypes.c_uint64,
+            ctypes.c_uint64, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_uint32, ctypes.c_uint64, ctypes.c_int,
+            ctypes.c_void_p,
+        ]
         _lib = lib
     return _lib
 
@@ -121,4 +131,65 @@ def epoch_indices_native(
     )
     if rc != 0:
         raise ValueError(f"psds_epoch_indices failed with code {rc}")
+    return out
+
+
+def mixture_epoch_indices_native(
+    spec,
+    seed: int,
+    epoch: int,
+    rank: int,
+    world: int,
+    *,
+    epoch_samples=None,
+    shuffle: bool = True,
+    drop_last: bool = False,
+    order_windows: bool = True,
+    partition: str = "strided",
+    rounds: int = core.DEFAULT_ROUNDS,
+) -> np.ndarray:
+    """Bit-identical to ``ops.mixture.mixture_epoch_indices_np`` via the
+    C++ §8 kernel (both pattern versions).  The spec's static tables
+    (pattern, prefix, quotas, capped windows) ride as pointers; the
+    kernel amortizes per-(source, pass, window) key work exactly like
+    the single-source path's cached-window trick."""
+    from .mixture import mixture_epoch_sizes
+
+    if not (0 <= rank < world):
+        raise ValueError(f"rank must be in [0, {world}), got {rank}")
+    if partition not in ("strided", "blocked"):
+        raise ValueError(
+            f"partition must be 'strided' or 'blocked', got {partition!r}"
+        )
+    if rounds > 64:
+        raise ValueError("native path supports rounds <= 64")
+    lib = _load()
+    _t, num_samples, _total = mixture_epoch_sizes(
+        spec, epoch_samples, world, drop_last
+    )
+    dtype = (
+        np.int32 if spec.total_sources_len <= 0x7FFFFFFF else np.int64
+    )
+    out = np.empty(num_samples, dtype=dtype)
+    lo, hi = core.fold_seed(int(seed))
+    sources = np.ascontiguousarray(spec.sources, dtype=np.uint64)
+    windows = np.ascontiguousarray(spec.windows, dtype=np.uint32)
+    quotas = np.ascontiguousarray(spec.quotas, dtype=np.uint64)
+    pattern = np.ascontiguousarray(spec.pattern, dtype=np.int32)
+    prefix = np.ascontiguousarray(spec.prefix, dtype=np.int64)
+    rc = lib.psds_mixture_indices(
+        spec.num_sources,
+        sources.ctypes.data_as(ctypes.c_void_p),
+        windows.ctypes.data_as(ctypes.c_void_p),
+        pattern.ctypes.data_as(ctypes.c_void_p),
+        prefix.ctypes.data_as(ctypes.c_void_p),
+        quotas.ctypes.data_as(ctypes.c_void_p),
+        spec.block, int(spec.rotated(shuffle)),
+        lo, hi, int(epoch) & 0xFFFFFFFF, rank, world,
+        int(bool(shuffle)), int(bool(order_windows)),
+        int(partition == "strided"), rounds, num_samples,
+        out.itemsize, out.ctypes.data_as(ctypes.c_void_p),
+    )
+    if rc != 0:
+        raise ValueError(f"psds_mixture_indices failed with code {rc}")
     return out

@@ -56,12 +56,14 @@ class HostDataLoader:
         are live at once (the producer holds one more while the queue is
         full).  The default 1 therefore double-buffers.
     index_backend: 'cpu' (numpy regen, default), 'native' (C++ host
-        kernel), 'xla' (device regen + one host readback per epoch —
-        only worth it when the rank's shard is large), or 'auto'
-        (cost-based pick per shard size, utils/autotune — the same rule
-        as the torch shim's ``backend='auto'``).  The mixture stream has
-        no native kernel: 'native' is rejected there and 'auto' resolves
-        between 'cpu' and 'xla'.
+        kernels — the §3 epoch stream and the §8 mixture stream),
+        'xla' (device regen + one host readback per epoch — only worth
+        it when the rank's shard is large), or 'auto'.  For the
+        single-source stream 'auto' is the measured cost-based pick
+        (utils/autotune, the torch shim's rule); mixture and shard-mode
+        streams resolve 'auto' host-side ('native' when built, else
+        'cpu') because the model prices the single-source evaluator —
+        pass 'xla' explicitly to pin the device path there.
     mixture: a ``MixtureSpec`` — serve the §8 stream (global ids into the
         concatenated source space); ``epoch_samples`` sets the mixture
         epoch length T.  Mutually exclusive with ``shard_sizes``;
@@ -185,8 +187,13 @@ class HostDataLoader:
                 # the cost model prices the SINGLE-SOURCE evaluator; the
                 # mixture stream's per-sample costs differ ~10x on both
                 # arms, so 'auto' stays host-side here (pass 'xla'
-                # explicitly to pin the device path)
-                index_backend = "cpu"
+                # explicitly to pin the device path); the C++ §8 kernel
+                # is the fast host path when built
+                from ..ops import native as _native
+
+                index_backend = (
+                    "native" if _native.available() else "cpu"
+                )
             elif self.shard_sizes is not None:
                 # the shard-ID stream 'auto' would price is the trivial
                 # part; the dominant cost is the O(total-samples) host
@@ -200,11 +207,6 @@ class HostDataLoader:
                 from ..utils.autotune import pick_backend
 
                 index_backend, self._auto_cost = pick_backend(num_samples)
-        if mixture is not None and index_backend == "native":
-            raise ValueError(
-                "index_backend: the mixture stream has no native kernel; "
-                "use 'cpu', 'xla', or 'auto'"
-            )
         try:
             ensure_index_backend(index_backend)  # incl. native build, eagerly
         except ValueError as exc:
@@ -355,6 +357,8 @@ class HostDataLoader:
                     self.mixture, self.seed, epoch, self.rank, self.world,
                     list(layers), **kw,
                 ))
+            # native serves the epoch stream; the (rare) elastic
+            # remainder rides the numpy reference
             return M.mixture_elastic_indices_np(
                 self.mixture, self.seed, epoch, self.rank, self.world,
                 list(layers), **kw,
@@ -363,6 +367,12 @@ class HostDataLoader:
             return np.asarray(M.mixture_epoch_indices_jax(
                 self.mixture, self.seed, epoch, self.rank, self.world, **kw,
             ))
+        if self.index_backend == "native":
+            from ..ops.native import mixture_epoch_indices_native
+
+            return mixture_epoch_indices_native(
+                self.mixture, self.seed, epoch, self.rank, self.world, **kw,
+            )
         return M.mixture_epoch_indices_np(
             self.mixture, self.seed, epoch, self.rank, self.world, **kw,
         )

@@ -52,8 +52,11 @@ class PartialShuffleMixtureSampler(ChunkedIterMixin, _TorchSampler):
                    whose weighted share exceeds their size repeat with a
                    fresh permutation per pass; smaller shares see a
                    weight-proportional prefix of a full permutation.
-    backend:       'cpu' (numpy) or 'xla' (device regen + one readback,
-                   with async epoch prefetch on ``set_epoch``).
+    backend:       'cpu' (numpy), 'native' (C++ §8 kernel, ~5x numpy;
+                   elastic remainder epochs fall back to numpy — they
+                   are rare events), or 'xla' (device regen + one
+                   readback).  Every backend prefetches async on
+                   ``set_epoch``.
 
     Yields python ints (global ids).  ``decompose(ids)`` maps ids back to
     (source_id, local_id).
@@ -98,10 +101,13 @@ class PartialShuffleMixtureSampler(ChunkedIterMixin, _TorchSampler):
                 f"partition must be 'strided' or 'blocked', got {partition!r}"
             )
         self.partition = partition
-        if backend not in ("cpu", "xla"):
+        if backend not in ("cpu", "native", "xla"):
             raise ValueError(
-                f"backend must be 'cpu' or 'xla', got {backend!r}"
+                f"backend must be 'cpu', 'native' or 'xla', got {backend!r}"
             )
+        from ..ops import ensure_index_backend
+
+        ensure_index_backend(backend)  # fail at construction, not epoch 1
         self.backend = backend
         self.rounds = int(rounds)
         self.epoch_samples = (
@@ -141,6 +147,19 @@ class PartialShuffleMixtureSampler(ChunkedIterMixin, _TorchSampler):
             **self._kwargs(),
         )
 
+    def _generate_host(self, epoch: int) -> np.ndarray:
+        if self.backend == "native":
+            from ..ops.native import mixture_epoch_indices_native
+
+            return mixture_epoch_indices_native(
+                self.spec, self.seed, epoch, self.rank, self.num_replicas,
+                **self._kwargs(),
+            )
+        return mixture_epoch_indices_np(
+            self.spec, self.seed, epoch, self.rank, self.num_replicas,
+            **self._kwargs(),
+        )
+
     def epoch_indices(self, epoch: Optional[int] = None) -> np.ndarray:
         """This rank's global-id order for ``epoch`` (default: current)."""
         e = self.epoch if epoch is None else int(epoch)
@@ -162,10 +181,7 @@ class PartialShuffleMixtureSampler(ChunkedIterMixin, _TorchSampler):
                 self._pending_epoch = None
                 if arr is not None:  # None: forked child, thread never ran
                     return arr
-            return mixture_epoch_indices_np(
-                self.spec, self.seed, e, self.rank, self.num_replicas,
-                **self._kwargs(),
-            )
+            return self._generate_host(e)
 
     def decompose(self, global_ids):
         """(source_id, local_id) arrays for served global ids."""
@@ -311,12 +327,7 @@ class PartialShuffleMixtureSampler(ChunkedIterMixin, _TorchSampler):
             # daemon thread so __iter__ finds the array ready
             from .torch_shim import _AsyncRegen
 
-            self._pending = _AsyncRegen(
-                lambda e=e: mixture_epoch_indices_np(
-                    self.spec, self.seed, e, self.rank, self.num_replicas,
-                    **self._kwargs(),
-                )
-            )
+            self._pending = _AsyncRegen(lambda e=e: self._generate_host(e))
             self._pending_epoch = e
 
     # ------------------------------------------------------ checkpoint state

@@ -205,8 +205,13 @@ def test_mixture_loader_epoch_samples_and_validation():
     assert np.array_equal(got, X[ref[:len(got)]])
     with pytest.raises(ValueError, match="window"):
         HostDataLoader(X, batch=25, mixture=spec, window=64)
-    with pytest.raises(ValueError, match="native"):
-        HostDataLoader(X, batch=25, mixture=spec, index_backend="native")
+    from partiallyshuffledistributedsampler_tpu.ops import native as _nat
+    if _nat.available():
+        nat = HostDataLoader(X, batch=25, mixture=spec,
+                             index_backend="native")
+        cpu_l = HostDataLoader(X, batch=25, mixture=spec)
+        for a, b in zip(nat.epoch(1), cpu_l.epoch(1)):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
     with pytest.raises(ValueError, match="sources sum"):
         HostDataLoader(np.arange(299), batch=25, mixture=spec)
     with pytest.raises(ValueError, match="epoch_samples"):

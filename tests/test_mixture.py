@@ -731,7 +731,7 @@ def test_sampler_validation_errors():
     with pytest.raises(ValueError, match="partition"):
         make_sampler(partition="zig")
     with pytest.raises(ValueError, match="backend"):
-        make_sampler(backend="native")
+        make_sampler(backend="gpu")
     with pytest.raises(ValueError, match="epoch_samples"):
         make_sampler(epoch_samples=0)
 
