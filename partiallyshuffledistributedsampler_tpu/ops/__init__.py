@@ -55,6 +55,16 @@ def ensure_index_backend(backend: str) -> None:
             ) from None
 
 
+def resolve_host_backend() -> str:
+    """The host-side 'auto' rule shared by every stream whose cost the
+    measured single-source model cannot price (mixture, shard-mode):
+    the native C++ kernel when built, numpy otherwise — ONE home, so the
+    samplers and loaders can never diverge on the same config."""
+    from . import native
+
+    return "native" if native.available() else "cpu"
+
+
 def epoch_indices_host(backend: str, n, window, seed, epoch, rank, world,
                        **kwargs):
     """One rank's epoch indices as a HOST numpy array via the chosen

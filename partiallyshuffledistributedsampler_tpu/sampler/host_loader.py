@@ -189,20 +189,16 @@ class HostDataLoader:
                 # arms, so 'auto' stays host-side here (pass 'xla'
                 # explicitly to pin the device path); the C++ §8 kernel
                 # is the fast host path when built
-                from ..ops import native as _native
+                from ..ops import resolve_host_backend
 
-                index_backend = (
-                    "native" if _native.available() else "cpu"
-                )
+                index_backend = resolve_host_backend()
             elif self.shard_sizes is not None:
                 # the shard-ID stream 'auto' would price is the trivial
                 # part; the dominant cost is the O(total-samples) host
                 # expansion, which no backend choice moves
-                from ..ops import native as _native
+                from ..ops import resolve_host_backend
 
-                index_backend = (
-                    "native" if _native.available() else "cpu"
-                )
+                index_backend = resolve_host_backend()
             else:
                 from ..utils.autotune import pick_backend
 

@@ -54,9 +54,12 @@ class PartialShuffleMixtureSampler(ChunkedIterMixin, _TorchSampler):
                    weight-proportional prefix of a full permutation.
     backend:       'cpu' (numpy), 'native' (C++ §8 kernel, ~5x numpy;
                    elastic remainder epochs fall back to numpy — they
-                   are rare events), or 'xla' (device regen + one
-                   readback).  Every backend prefetches async on
-                   ``set_epoch``.
+                   are rare events), 'xla' (device regen + one
+                   readback), or 'auto' (host-side pick: native when
+                   built, else cpu — the single-source shim's measured
+                   cost model prices a different evaluator, so the
+                   mixture stays off the device unless 'xla' is pinned).
+                   Every backend prefetches async on ``set_epoch``.
 
     Yields python ints (global ids).  ``decompose(ids)`` maps ids back to
     (source_id, local_id).
@@ -101,9 +104,14 @@ class PartialShuffleMixtureSampler(ChunkedIterMixin, _TorchSampler):
                 f"partition must be 'strided' or 'blocked', got {partition!r}"
             )
         self.partition = partition
+        if backend == "auto":
+            from ..ops import resolve_host_backend
+
+            backend = resolve_host_backend()
         if backend not in ("cpu", "native", "xla"):
             raise ValueError(
-                f"backend must be 'cpu', 'native' or 'xla', got {backend!r}"
+                f"backend must be 'cpu', 'native', 'xla' or 'auto', "
+                f"got {backend!r}"
             )
         from ..ops import ensure_index_backend
 

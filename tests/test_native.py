@@ -127,3 +127,20 @@ def test_native_mixture_sampler_backend():
     re = PartialShuffleMixtureSampler.reshard_from_state_dict(
         state, num_replicas=3, rank=0, backend="native")
     assert len(list(re)) == len(re)
+
+
+def test_native_mixture_sampler_auto_backend():
+    """backend='auto' on the mixture sampler resolves host-side: native
+    when the kernel is built (this suite builds it), same stream."""
+    from partiallyshuffledistributedsampler_tpu.sampler import (
+        PartialShuffleMixtureSampler,
+    )
+
+    s = PartialShuffleMixtureSampler([1000, 500], [3, 1], num_replicas=2,
+                                     rank=0, windows=64, block=20,
+                                     backend="auto")
+    assert s.backend == "native"
+    ref = PartialShuffleMixtureSampler([1000, 500], [3, 1], num_replicas=2,
+                                       rank=0, windows=64, block=20)
+    s.set_epoch(1), ref.set_epoch(1)
+    assert list(s) == list(ref)
