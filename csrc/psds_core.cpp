@@ -280,6 +280,80 @@ int mixture_indices_impl(uint32_t S, const uint64_t *sources,
   return 0;
 }
 
+// ---------------------------------------------------------------------------
+// SPEC.md §7: shard-index mode — expand a shard-id stream into global
+// sample indices, each shard §3-permuted under its spec'd per-shard seed.
+// Mirrors sampler/shard_mode.expand_shard_indices_np bit-for-bit.
+// ---------------------------------------------------------------------------
+
+constexpr uint64_t SHARD_SEED_STRIDE = 0x9E3779B97F4A7C15ull;
+
+template <typename OutT>
+int expand_shards_impl(const int64_t *sid_stream, uint64_t n_sids,
+                       const int64_t *sizes, const int64_t *offsets,
+                       uint64_t num_shards, uint32_t seed_lo,
+                       uint32_t seed_hi, uint32_t epoch, int full_shuffle,
+                       uint32_t w_int, uint32_t rounds, OutT *out) {
+  if (rounds > 64) return -2;
+  uint64_t k = 0;
+  for (uint64_t si = 0; si < n_sids; ++si) {
+    const int64_t sid = sid_stream[si];
+    if (sid < 0 || (uint64_t)sid >= num_shards) return -1;
+    const int64_t m64 = sizes[sid];
+    if (m64 < 0 || m64 > 0x7FFFFFFFll) return -3;
+    const uint32_t m = (uint32_t)m64;
+    const int64_t off = offsets[sid];
+    if (m == 0) continue;
+    // §7 resolved window: True -> whole shard; int w capped at m;
+    // w <= 1 -> sequential (identity)
+    const uint32_t W = full_shuffle ? m : (w_int < m ? w_int : m);
+    if (W <= 1) {
+      for (uint32_t u = 0; u < m; ++u) out[k++] = (OutT)(off + u);
+      continue;
+    }
+    // the spec'd per-shard seed: fold(seed) XOR split halves of
+    // (STRIDE + sid), exactly _shard_epoch_keys' decomposition
+    const uint64_t d = SHARD_SEED_STRIDE + (uint64_t)sid;
+    const uint32_t lo = seed_lo ^ (uint32_t)d;
+    const uint32_t hi = seed_hi ^ (uint32_t)(d >> 32);
+    const uint32_t ek = derive_epoch_key(lo, hi, epoch);
+    // order_windows is True only for the full shuffle (bounded windows
+    // stay put so displacement stays < W) — and full shuffle has nw=1,
+    // so the outer bijection never actually runs; §3 body+tail follow
+    const uint32_t nw = m / W;
+    const uint64_t body = (uint64_t)nw * W;
+    const uint32_t tail = (uint32_t)(m - body);
+    const uint32_t okey = mix32(ek ^ C_OUTER);
+    const uint32_t tkey = mix32(ek ^ C_TAIL);
+    const bool do_outer = full_shuffle && nw > 1;  // nw==1 when full
+    SonSchedule inner_sched;
+    make_schedule(inner_sched, W, mix32(ek ^ C_PAIR), rounds);
+    uint64_t cached_j = ~0ull;
+    uint32_t cached_kw = 0, cached_key2 = 0;
+    for (uint32_t u = 0; u < m; ++u) {
+      uint64_t idx;
+      if (u < body) {
+        const uint64_t j = u / W;
+        const uint32_t r0 = (uint32_t)(u % W);
+        if (j != cached_j) {
+          cached_j = j;
+          cached_kw = do_outer ? son((uint32_t)j, nw, okey, rounds)
+                               : (uint32_t)j;
+          const uint32_t kin = mix32(ek ^ C_INNER ^ mix32(cached_kw ^ C_WIN));
+          cached_key2 = mix32(kin ^ C_BIT);
+        }
+        idx = (uint64_t)cached_kw * W + son_apply(inner_sched, r0,
+                                                  cached_key2);
+      } else {
+        const uint32_t t = (uint32_t)(u - body);
+        idx = body + son(t, tail, tkey, rounds);
+      }
+      out[k++] = (OutT)(off + (int64_t)idx);
+    }
+  }
+  return 0;
+}
+
 } // namespace
 
 extern "C" {
@@ -337,6 +411,31 @@ int psds_mixture_indices(uint32_t S, const uint64_t *sources,
         S, sources, windows, pattern, prefix, quotas, B, rotated, seed_lo,
         seed_hi, epoch, rank, world, shuffle, order_windows, strided, rounds,
         num_samples, (int64_t *)out);
+  return -5;
+}
+
+// Expands a shard-id stream (SPEC.md §7) into out[0..sum(sizes[sid]))
+// global sample indices, each shard permuted under its per-shard seed.
+// full_shuffle selects the whole-shard §3 permutation; otherwise w_int is
+// the bounded within-shard window (<= 1 means sequential).  out_width as
+// above (4 requires the total sample space <= 2^31-1 — the caller
+// guarantees it, matching expand_shard_indices_np's int64/int32 law).
+int psds_expand_shards(const int64_t *sid_stream, uint64_t n_sids,
+                       const int64_t *sizes, const int64_t *offsets,
+                       uint64_t num_shards, uint32_t seed_lo,
+                       uint32_t seed_hi, uint32_t epoch, int full_shuffle,
+                       uint32_t w_int, uint32_t rounds, int out_width,
+                       void *out) {
+  if (out_width == 4)
+    return expand_shards_impl<int32_t>(sid_stream, n_sids, sizes, offsets,
+                                       num_shards, seed_lo, seed_hi, epoch,
+                                       full_shuffle, w_int, rounds,
+                                       (int32_t *)out);
+  if (out_width == 8)
+    return expand_shards_impl<int64_t>(sid_stream, n_sids, sizes, offsets,
+                                       num_shards, seed_lo, seed_hi, epoch,
+                                       full_shuffle, w_int, rounds,
+                                       (int64_t *)out);
   return -5;
 }
 

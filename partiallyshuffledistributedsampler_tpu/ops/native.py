@@ -74,6 +74,14 @@ def _load() -> ctypes.CDLL:
             ctypes.c_uint32, ctypes.c_uint64, ctypes.c_int,
             ctypes.c_void_p,
         ]
+        lib.psds_expand_shards.restype = ctypes.c_int
+        lib.psds_expand_shards.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint32,
+            ctypes.c_uint32, ctypes.c_uint32, ctypes.c_int,
+            ctypes.c_uint32, ctypes.c_uint32, ctypes.c_int,
+            ctypes.c_void_p,
+        ]
         lib.psds_mixture_indices.restype = ctypes.c_int
         lib.psds_mixture_indices.argtypes = [
             ctypes.c_uint32, ctypes.c_void_p, ctypes.c_void_p,
@@ -131,6 +139,56 @@ def epoch_indices_native(
     )
     if rc != 0:
         raise ValueError(f"psds_epoch_indices failed with code {rc}")
+    return out
+
+
+def expand_shard_indices_native(
+    shard_ids,
+    shard_sizes,
+    *,
+    seed: int = 0,
+    epoch: int = 0,
+    within_shard_shuffle=True,
+    rounds: int = core.DEFAULT_ROUNDS,
+) -> np.ndarray:
+    """Bit-identical to ``shard_mode.expand_shard_indices_np`` via the
+    C++ §7 kernel — the fast host path for torch shard-mode pipelines
+    without jax (the 1e8-index full in-shard shuffle is ~51 s through
+    numpy's per-size-class batching)."""
+    if rounds > 64:
+        raise ValueError("native path supports rounds <= 64")
+    lib = _load()
+    sizes = np.ascontiguousarray(shard_sizes, dtype=np.int64)
+    offsets = np.concatenate([[0], np.cumsum(sizes)[:-1]]).astype(np.int64)
+    sids = np.ascontiguousarray(list(shard_ids), dtype=np.int64)
+    if sids.size and (sids.min() < 0 or sids.max() >= len(sizes)):
+        raise ValueError(
+            f"shard ids must be in [0, {len(sizes)}); got range "
+            f"[{sids.min()}, {sids.max()}]"
+        )
+    total = int(sizes[sids].sum()) if sids.size else 0
+    out = np.empty(total, dtype=np.int64)
+    if total == 0:
+        return out
+    lo, hi = core.fold_seed(int(seed))
+    full = within_shard_shuffle is True
+    w_int = 0 if full else int(within_shard_shuffle)
+    if w_int < 0:
+        raise ValueError(
+            f"within_shard_shuffle must be bool or >= 0, got {w_int}"
+        )
+    # any window covering the largest shard is already 'whole shard';
+    # capping keeps the uint32 C ABI exact for arbitrarily large ints
+    w_int = min(w_int, 0x7FFFFFFF)
+    rc = lib.psds_expand_shards(
+        sids.ctypes.data_as(ctypes.c_void_p), len(sids),
+        sizes.ctypes.data_as(ctypes.c_void_p),
+        offsets.ctypes.data_as(ctypes.c_void_p), len(sizes),
+        lo, hi, int(epoch) & 0xFFFFFFFF, int(full), w_int, rounds,
+        out.itemsize, out.ctypes.data_as(ctypes.c_void_p),
+    )
+    if rc != 0:
+        raise ValueError(f"psds_expand_shards failed with code {rc}")
     return out
 
 

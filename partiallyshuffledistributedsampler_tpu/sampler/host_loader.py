@@ -310,9 +310,11 @@ class HostDataLoader:
         base = self._base_indices(epoch, layers)
         if self.shard_sizes is None:
             return base
-        from .shard_mode import expand_shard_indices_np
-
-        return expand_shard_indices_np(
+        if self.index_backend == "native":
+            from ..ops.native import expand_shard_indices_native as expand
+        else:
+            from .shard_mode import expand_shard_indices_np as expand
+        return expand(
             base, self.shard_sizes, seed=self.seed, epoch=epoch,
             within_shard_shuffle=self.within_shard_shuffle,
             rounds=self.kwargs.get("rounds", core.DEFAULT_ROUNDS),

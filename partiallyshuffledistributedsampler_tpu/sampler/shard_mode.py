@@ -187,6 +187,17 @@ _EXPAND_BLOCK = 8192
 _BATCH_ELEMS = 1 << 22
 
 
+def _validate_sids(sids: np.ndarray, num_shards: int) -> None:
+    """An out-of-range shard id would wrap through numpy's negative
+    indexing into a DIFFERENT shard's expansion (and the native kernel
+    refuses it) — fail identically on every backend instead."""
+    if sids.size and (sids.min() < 0 or int(sids.max()) >= num_shards):
+        raise ValueError(
+            f"shard ids must be in [0, {num_shards}); got range "
+            f"[{sids.min()}, {sids.max()}]"
+        )
+
+
 def _size_class_members(m_of: np.ndarray):
     """Yield ``(m, members)`` index arrays grouped by shard size, from ONE
     stable argsort — O(S log S) no matter how many distinct sizes there
@@ -247,6 +258,7 @@ def expand_shard_indices_np(
     sizes = np.asarray(shard_sizes, dtype=np.int64)
     offsets = np.concatenate([[0], np.cumsum(sizes)[:-1]])
     sids = np.asarray(list(shard_ids), dtype=np.int64)
+    _validate_sids(sids, len(sizes))
     if sids.size == 0:
         return np.empty(0, dtype=np.int64)
     m_of = sizes[sids]
@@ -479,6 +491,7 @@ def expand_shard_indices_jax(
     sizes = np.asarray(shard_sizes, dtype=np.int64)
     offsets = np.concatenate([[0], np.cumsum(sizes)[:-1]])
     sids = np.asarray(list(shard_ids), dtype=np.int64)
+    _validate_sids(sids, len(sizes))
     total_space = int(sizes.sum())
     big = total_space > 0x7FFFFFFF
     if big:

@@ -144,3 +144,56 @@ def test_native_mixture_sampler_auto_backend():
                                        rank=0, windows=64, block=20)
     s.set_epoch(1), ref.set_epoch(1)
     assert list(s) == list(ref)
+
+
+# -------------------------------------------------- §7 shard expansion
+def test_native_shard_expansion_bit_identical():
+    """The C++ §7 expansion must equal the numpy batched expansion across
+    every shuffle mode, zero/one-sample shards, and variable sizes."""
+    from partiallyshuffledistributedsampler_tpu.ops.native import (
+        expand_shard_indices_native,
+    )
+    from partiallyshuffledistributedsampler_tpu.sampler.shard_mode import (
+        expand_shard_indices_np,
+    )
+
+    rng = np.random.default_rng(7)
+    sizes = np.concatenate([rng.integers(0, 400, 300), [0, 1, 2],
+                            rng.integers(200, 2000, 200)])
+    sid = rng.permutation(len(sizes))[:400]
+    for wss in (True, False, 0, 3, 64, 5000):
+        a = expand_shard_indices_np(sid, sizes, seed=5, epoch=2,
+                                    within_shard_shuffle=wss)
+        b = expand_shard_indices_native(sid, sizes, seed=5, epoch=2,
+                                        within_shard_shuffle=wss)
+        assert np.array_equal(a, b), wss
+    assert len(expand_shard_indices_native([], sizes)) == 0
+    # huge int windows cap identically to numpy (no uint32 ABI wrap)
+    a = expand_shard_indices_np(sid, sizes, seed=5, epoch=2,
+                                within_shard_shuffle=2**32)
+    b = expand_shard_indices_native(sid, sizes, seed=5, epoch=2,
+                                    within_shard_shuffle=2**32)
+    assert np.array_equal(a, b)
+    # out-of-range shard ids fail identically on both paths
+    for fn in (expand_shard_indices_np, expand_shard_indices_native):
+        with pytest.raises(ValueError, match="shard ids"):
+            fn([-1], sizes)
+        with pytest.raises(ValueError, match="shard ids"):
+            fn([len(sizes)], sizes)
+
+
+def test_native_shard_expansion_in_host_loader():
+    """HostDataLoader(shard_sizes=..., index_backend='native') expands
+    through the C++ kernel and serves the identical stream."""
+    from partiallyshuffledistributedsampler_tpu.sampler import (
+        HostDataLoader,
+    )
+
+    rng = np.random.default_rng(3)
+    sizes = rng.integers(50, 200, 120)
+    X = np.arange(int(sizes.sum()))
+    a = HostDataLoader(X, batch=64, window=16, shard_sizes=sizes, seed=5,
+                       index_backend="native")
+    b = HostDataLoader(X, batch=64, window=16, shard_sizes=sizes, seed=5)
+    for ba, bb in zip(a.epoch(2), b.epoch(2)):
+        assert np.array_equal(np.asarray(ba), np.asarray(bb))
