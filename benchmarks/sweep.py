@@ -114,12 +114,12 @@ def main() -> None:
     for n in scales:
         parts = [n * 7 // 10, n * 2 // 10, n - n * 7 // 10 - n * 2 // 10]
         spec = MixtureSpec(parts, [70, 20, 10], windows=min(WINDOW, parts[-1]))
-        for label, am in (("mixture_amortized", True),
-                          ("mixture_general", False)):
+        for label, kw in (("mixture_fused", {}),
+                          ("mixture_masked", {"fused": False})):
             try:
                 ms = _steady_ms_device(
-                    lambda e, spec=spec, am=am: mixture_epoch_indices_jax(
-                        spec, 0, e, 0, WORLD, amortize=am
+                    lambda e, spec=spec, kw=kw: mixture_epoch_indices_jax(
+                        spec, 0, e, 0, WORLD, **kw
                     )
                 )
                 print(json.dumps({
