@@ -445,3 +445,32 @@ def test_mixture_fused_equals_masked_random_configs(cfg, pv):
     b = M.mixture_stream_at_generic(np, pos, spec, cfg["seed"],
                                     cfg["epoch"], fused=True)
     assert np.array_equal(a, b)
+
+
+@settings(max_examples=40, **SETTINGS)
+@given(cfg=MIX_CONFIGS, pv=st.integers(1, 2))
+def test_mixture_native_equals_numpy_random_configs(cfg, pv):
+    """The C++ §8 kernel vs the numpy reference over random configs and
+    both pattern versions — the executor-matrix counterpart of the fused
+    fuzz (pass wrapping, rotation, tails, partitions, epoch lengths)."""
+    from partiallyshuffledistributedsampler_tpu.ops import mixture as M
+    from partiallyshuffledistributedsampler_tpu.ops import native
+
+    if not native.available():
+        return  # toolchain-less env: the dedicated suite skips too
+    spec = _mix_spec(cfg)
+    if spec is None:
+        return
+    if pv == 1:
+        spec = M.MixtureSpec(spec.sources, spec.weights,
+                             windows=list(spec.windows), block=spec.block,
+                             pattern_version=1)
+    world = cfg["world"]
+    rank = cfg["weights_seed"] % world
+    kw = dict(partition=cfg["partition"],
+              epoch_samples=1 + cfg["block"] * 3)
+    a = M.mixture_epoch_indices_np(spec, cfg["seed"], cfg["epoch"], rank,
+                                   world, **kw)
+    b = native.mixture_epoch_indices_native(spec, cfg["seed"],
+                                            cfg["epoch"], rank, world, **kw)
+    assert np.array_equal(a, b)
