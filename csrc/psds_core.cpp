@@ -179,7 +179,10 @@ int mixture_indices_impl(uint32_t S, const uint64_t *sources,
                          uint32_t seed_hi, uint32_t epoch, uint64_t rank,
                          uint64_t world, int shuffle, int order_windows,
                          int strided, uint32_t rounds, uint64_t num_samples,
-                         OutT *out) {
+                         const int64_t *positions, OutT *out) {
+  // positions != null: evaluate the stream AT those positions (random
+  // access — the elastic remainder path composes them host-side);
+  // positions == null: generate the rank's §8.4 epoch positions
   if (S == 0 || world == 0 || rank >= world || B == 0) return -1;
   if (rounds > 64) return -2;
   std::vector<MixSrc> src(S);
@@ -216,7 +219,13 @@ int mixture_indices_impl(uint32_t S, const uint64_t *sources,
 
   for (uint64_t i = 0; i < num_samples; ++i) {
     // §8.4 positions are NOT wrapped: the stream is total
-    const uint64_t p = strided ? rank + world * i : rank * num_samples + i;
+    uint64_t p;
+    if (positions) {
+      if (positions[i] < 0) return -1;
+      p = (uint64_t)positions[i];
+    } else {
+      p = strided ? rank + world * i : rank * num_samples + i;
+    }
     const uint32_t t = (uint32_t)(p % B);
     const uint64_t blk = p / B;
     uint32_t slot = t;
@@ -404,13 +413,42 @@ int psds_mixture_indices(uint32_t S, const uint64_t *sources,
     return mixture_indices_impl<int32_t>(
         S, sources, windows, pattern, prefix, quotas, B, rotated, seed_lo,
         seed_hi, epoch, rank, world, shuffle, order_windows, strided, rounds,
-        num_samples, (int32_t *)out);
+        num_samples, nullptr, (int32_t *)out);
   }
   if (out_width == 8)
     return mixture_indices_impl<int64_t>(
         S, sources, windows, pattern, prefix, quotas, B, rotated, seed_lo,
         seed_hi, epoch, rank, world, shuffle, order_windows, strided, rounds,
-        num_samples, (int64_t *)out);
+        num_samples, nullptr, (int64_t *)out);
+  return -5;
+}
+
+// Random access into the §8 stream: out[i] = mix(positions[i]) — the
+// elastic remainder path composes base-epoch positions host-side (tiny,
+// O(len) arithmetic) and evaluates them here.  Same tables/flags as
+// psds_mixture_indices.
+int psds_mixture_stream_at(uint32_t S, const uint64_t *sources,
+                           const uint32_t *windows, const int32_t *pattern,
+                           const int64_t *prefix, const uint64_t *quotas,
+                           uint32_t B, int rotated, uint32_t seed_lo,
+                           uint32_t seed_hi, uint32_t epoch,
+                           int shuffle, int order_windows, uint32_t rounds,
+                           uint64_t n_positions, const int64_t *positions,
+                           int out_width, void *out) {
+  if (out_width == 4) {
+    uint64_t total = 0;
+    for (uint32_t s = 0; s < S; ++s) total += sources[s];
+    if (total > 0x7FFFFFFFull) return -4;
+    return mixture_indices_impl<int32_t>(
+        S, sources, windows, pattern, prefix, quotas, B, rotated, seed_lo,
+        seed_hi, epoch, 0, 1, shuffle, order_windows, 1, rounds,
+        n_positions, positions, (int32_t *)out);
+  }
+  if (out_width == 8)
+    return mixture_indices_impl<int64_t>(
+        S, sources, windows, pattern, prefix, quotas, B, rotated, seed_lo,
+        seed_hi, epoch, 0, 1, shuffle, order_windows, 1, rounds,
+        n_positions, positions, (int64_t *)out);
   return -5;
 }
 

@@ -92,6 +92,15 @@ def _load() -> ctypes.CDLL:
             ctypes.c_uint32, ctypes.c_uint64, ctypes.c_int,
             ctypes.c_void_p,
         ]
+        lib.psds_mixture_stream_at.restype = ctypes.c_int
+        lib.psds_mixture_stream_at.argtypes = [
+            ctypes.c_uint32, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_uint32, ctypes.c_int, ctypes.c_uint32,
+            ctypes.c_uint32, ctypes.c_uint32, ctypes.c_int, ctypes.c_int,
+            ctypes.c_uint32, ctypes.c_uint64, ctypes.c_void_p,
+            ctypes.c_int, ctypes.c_void_p,
+        ]
         _lib = lib
     return _lib
 
@@ -251,3 +260,90 @@ def mixture_epoch_indices_native(
     if rc != 0:
         raise ValueError(f"psds_mixture_indices failed with code {rc}")
     return out
+
+
+def mixture_stream_at_native(
+    positions,
+    spec,
+    seed: int,
+    epoch: int,
+    *,
+    shuffle: bool = True,
+    order_windows: bool = True,
+    rounds: int = core.DEFAULT_ROUNDS,
+) -> np.ndarray:
+    """Random access into the §8 stream via the C++ kernel — bit-identical
+    to ``ops.mixture.mixture_stream_at_np`` for non-negative positions."""
+    if rounds > 64:
+        raise ValueError("native path supports rounds <= 64")
+    lib = _load()
+    pos = np.ascontiguousarray(positions, dtype=np.int64)
+    if pos.size and pos.min() < 0:
+        raise ValueError("mixture positions must be >= 0")
+    dtype = (
+        np.int32 if spec.total_sources_len <= 0x7FFFFFFF else np.int64
+    )
+    out = np.empty(pos.size, dtype=dtype)
+    if pos.size == 0:
+        return out.reshape(pos.shape)
+    lo, hi = core.fold_seed(int(seed))
+    sources = np.ascontiguousarray(spec.sources, dtype=np.uint64)
+    windows = np.ascontiguousarray(spec.windows, dtype=np.uint32)
+    quotas = np.ascontiguousarray(spec.quotas, dtype=np.uint64)
+    pattern = np.ascontiguousarray(spec.pattern, dtype=np.int32)
+    prefix = np.ascontiguousarray(spec.prefix, dtype=np.int64)
+    rc = lib.psds_mixture_stream_at(
+        spec.num_sources,
+        sources.ctypes.data_as(ctypes.c_void_p),
+        windows.ctypes.data_as(ctypes.c_void_p),
+        pattern.ctypes.data_as(ctypes.c_void_p),
+        prefix.ctypes.data_as(ctypes.c_void_p),
+        quotas.ctypes.data_as(ctypes.c_void_p),
+        spec.block, int(spec.rotated(shuffle)),
+        lo, hi, int(epoch) & 0xFFFFFFFF,
+        int(bool(shuffle)), int(bool(order_windows)), rounds,
+        pos.size, pos.ctypes.data_as(ctypes.c_void_p),
+        out.itemsize, out.ctypes.data_as(ctypes.c_void_p),
+    )
+    if rc != 0:
+        raise ValueError(f"psds_mixture_stream_at failed with code {rc}")
+    return out.reshape(pos.shape)  # the numpy reference preserves shape
+
+
+def mixture_elastic_indices_native(
+    spec,
+    seed: int,
+    epoch: int,
+    rank: int,
+    world: int,
+    layers,
+    *,
+    epoch_samples=None,
+    shuffle: bool = True,
+    drop_last: bool = False,
+    order_windows: bool = True,
+    partition: str = "strided",
+    rounds: int = core.DEFAULT_ROUNDS,
+) -> np.ndarray:
+    """Bit-identical to ``ops.mixture.mixture_elastic_indices_np`` via the
+    C++ stream-at kernel: the §6 position composition is O(len) host
+    arithmetic (numpy), the §8 evaluation at those positions runs native."""
+    T = (spec.total_sources_len if epoch_samples is None
+         else int(epoch_samples))
+    chain, remaining, num_samples = core.elastic_chain(
+        T, layers, int(world), bool(drop_last)
+    )
+    dtype = (
+        np.int32 if spec.total_sources_len <= 0x7FFFFFFF else np.int64
+    )
+    if remaining == 0 or num_samples == 0:
+        return np.empty(0, dtype=dtype)
+    q = core.rank_positions(
+        np, remaining, int(rank), int(world), num_samples, partition,
+        np.uint64,
+    )
+    pos = core.compose_remainder_chain(np, q, chain, partition, np.uint64)
+    return mixture_stream_at_native(
+        pos.astype(np.int64), spec, seed, epoch,
+        shuffle=shuffle, order_windows=order_windows, rounds=rounds,
+    )

@@ -355,8 +355,13 @@ class HostDataLoader:
                     self.mixture, self.seed, epoch, self.rank, self.world,
                     list(layers), **kw,
                 ))
-            # native serves the epoch stream; the (rare) elastic
-            # remainder rides the numpy reference
+            if self.index_backend == "native":
+                from ..ops.native import mixture_elastic_indices_native
+
+                return mixture_elastic_indices_native(
+                    self.mixture, self.seed, epoch, self.rank, self.world,
+                    list(layers), **kw,
+                )
             return M.mixture_elastic_indices_np(
                 self.mixture, self.seed, epoch, self.rank, self.world,
                 list(layers), **kw,

@@ -52,9 +52,9 @@ class PartialShuffleMixtureSampler(ChunkedIterMixin, _TorchSampler):
                    whose weighted share exceeds their size repeat with a
                    fresh permutation per pass; smaller shares see a
                    weight-proportional prefix of a full permutation.
-    backend:       'cpu' (numpy), 'native' (C++ §8 kernel, ~5x numpy;
-                   elastic remainder epochs fall back to numpy — they
-                   are rare events), 'xla' (device regen + one
+    backend:       'cpu' (numpy), 'native' (C++ §8 kernels, ~5x numpy,
+                   elastic remainder epochs included), 'xla' (device
+                   regen + one
                    readback), or 'auto' (host-side pick: native when
                    built, else cpu — the single-source shim's measured
                    cost model prices a different evaluator, so the
@@ -236,6 +236,13 @@ class PartialShuffleMixtureSampler(ChunkedIterMixin, _TorchSampler):
                     self.spec, self.seed, epoch, self.rank,
                     self.num_replicas, el["layers"], **kw,
                 ))
+            elif self.backend == "native":
+                from ..ops.native import mixture_elastic_indices_native
+
+                arr = mixture_elastic_indices_native(
+                    self.spec, self.seed, epoch, self.rank,
+                    self.num_replicas, el["layers"], **kw,
+                )
             else:
                 arr = mixture_elastic_indices_np(
                     self.spec, self.seed, epoch, self.rank,

@@ -197,3 +197,56 @@ def test_native_shard_expansion_in_host_loader():
     b = HostDataLoader(X, batch=64, window=16, shard_sizes=sizes, seed=5)
     for ba, bb in zip(a.epoch(2), b.epoch(2)):
         assert np.array_equal(np.asarray(ba), np.asarray(bb))
+
+
+def test_native_mixture_stream_at_and_elastic():
+    """The C++ stream-at kernel: random access and the §6-over-§8 elastic
+    remainder bit-identical to numpy, through the sampler and loader
+    native backends too."""
+    from partiallyshuffledistributedsampler_tpu.ops import mixture as M
+    from partiallyshuffledistributedsampler_tpu.ops.native import (
+        mixture_elastic_indices_native, mixture_stream_at_native,
+    )
+    from partiallyshuffledistributedsampler_tpu.sampler import (
+        HostDataLoader, PartialShuffleMixtureSampler,
+    )
+
+    rng = np.random.default_rng(0)
+    for pv in (1, 2):
+        spec = M.MixtureSpec([1000, 500, 2500], [5, 1, 4], windows=64,
+                             block=100, pattern_version=pv)
+        pos = np.concatenate([np.arange(2000),
+                              rng.integers(0, 50_000, 300)])
+        assert np.array_equal(
+            M.mixture_stream_at_np(pos, spec, 12345678901, 3),
+            mixture_stream_at_native(pos, spec, 12345678901, 3))
+        # multi-dim positions keep their shape, like the numpy reference
+        p2 = pos[:12].reshape(3, 4)
+        got2 = mixture_stream_at_native(p2, spec, 12345678901, 3)
+        ref2 = M.mixture_stream_at_np(p2, spec, 12345678901, 3)
+        assert got2.shape == ref2.shape == (3, 4)
+        assert np.array_equal(got2, ref2)
+        for layers in ([(4, 100)], [(4, 100), (3, 50)]):
+            assert np.array_equal(
+                M.mixture_elastic_indices_np(spec, 7, 3, 1, 2, layers),
+                mixture_elastic_indices_native(spec, 7, 3, 1, 2, layers))
+    # through the torch sampler's native reshard path
+    base = PartialShuffleMixtureSampler([1000, 500, 2500], [5, 1, 4],
+                                        num_replicas=4, rank=0, windows=64,
+                                        block=100)
+    base.set_epoch(2)
+    state = base.state_dict(consumed=100)
+    nat = PartialShuffleMixtureSampler.reshard_from_state_dict(
+        state, num_replicas=2, rank=1, backend="native")
+    cpu = PartialShuffleMixtureSampler.reshard_from_state_dict(
+        state, num_replicas=2, rank=1, backend="cpu")
+    assert list(nat) == list(cpu)
+    # through the loader's elastic native branch
+    spec = M.MixtureSpec([200, 100, 300], [3, 1, 2], windows=16, block=30)
+    X = np.arange(spec.total_sources_len)
+    a = HostDataLoader(X, batch=32, world=2, rank=0, mixture=spec,
+                       index_backend="native")
+    b = HostDataLoader(X, batch=32, world=2, rank=0, mixture=spec)
+    for ba, bb in zip(a.epoch(1, layers=[(3, 40)]),
+                      b.epoch(1, layers=[(3, 40)])):
+        assert np.array_equal(np.asarray(ba), np.asarray(bb))
