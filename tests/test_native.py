@@ -250,3 +250,36 @@ def test_native_mixture_stream_at_and_elastic():
     for ba, bb in zip(a.epoch(1, layers=[(3, 40)]),
                       b.epoch(1, layers=[(3, 40)])):
         assert np.array_equal(np.asarray(ba), np.asarray(bb))
+
+
+def test_native_batch_chunk_boundaries():
+    """Windows and shard sizes BIGGER than the kernels' SON_BATCH run
+    buffer (8192): the mid-window chunk continuation and the per-window
+    chunk loop must stitch bit-identically — the one path the standard
+    parity configs (all <= 8192) never reach."""
+    from partiallyshuffledistributedsampler_tpu.ops import native
+    from partiallyshuffledistributedsampler_tpu.ops.cpu import (
+        epoch_indices_np,
+    )
+    from partiallyshuffledistributedsampler_tpu.sampler.shard_mode import (
+        expand_shard_indices_np,
+    )
+
+    # epoch regen: window 20_000 > 8192 -> every window spans 3 chunks
+    for world, part in [(1, "strided"), (3, "strided"), (2, "blocked")]:
+        for rank in range(world):
+            a = epoch_indices_np(100_000, 20_000, 42, 5, rank, world,
+                                 partition=part)
+            b = native.epoch_indices_native(100_000, 20_000, 42, 5, rank,
+                                            world, partition=part)
+            assert np.array_equal(a, b), (world, part, rank)
+    # shard expansion: a 30_000-sample shard (full shuffle AND bounded
+    # window 9000 > 8192) chunks inside one window
+    sizes = np.asarray([30_000, 500, 9_500])
+    sid = [2, 0, 1, 0]
+    for wss in (True, 9000):
+        a = expand_shard_indices_np(sid, sizes, seed=3, epoch=1,
+                                    within_shard_shuffle=wss)
+        b = native.expand_shard_indices_native(sid, sizes, seed=3, epoch=1,
+                                               within_shard_shuffle=wss)
+        assert np.array_equal(a, b), wss
