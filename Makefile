@@ -18,15 +18,17 @@
 #   * dryrun               8-virtual-device mesh: full sharded train step
 #   * bench smoke          bench.py with PSDS_BENCH_SMOKE=1 — the metric
 #                          pipeline end to end, reduced reps
+#   * service smoke        benchmarks/service_smoke.py — index daemon +
+#                          4 clients, streams == local sampler, metrics
 
 PY ?= python
 
-.PHONY: check test bench native dryrun
+.PHONY: check test bench native dryrun service-smoke
 
 # the driver parses the LAST line of bench.py's combined output (round 3
 # lost its headline to the details line — BENCH_r03.json "parsed": null),
 # so the gate replicates that read and asserts it yields the metric
-check: test dryrun
+check: test dryrun service-smoke
 	PSDS_BENCH_SMOKE=1 $(PY) bench.py >.bench_smoke.out 2>&1 \
 		|| { cat .bench_smoke.out; exit 1; }
 	@cat .bench_smoke.out
@@ -49,6 +51,12 @@ dryrun:
 
 bench:
 	$(PY) bench.py
+
+# index-service gate: daemon on an ephemeral loopback port, one epoch
+# through 4 concurrent clients, streams asserted bit-identical to the
+# local sampler, metrics endpoint asserted to account for the traffic
+service-smoke:
+	$(PY) benchmarks/service_smoke.py
 
 native:
 	$(MAKE) -C csrc

@@ -257,6 +257,17 @@ def main() -> None:
         except Exception as exc:
             details["stall_error"] = repr(exc)[:200]
 
+    # detail tier: index-service per-batch overhead vs the local path
+    # (loopback daemon + 4 clients; methodology in benchmarks/service_smoke.py)
+    if not smoke:
+        try:
+            sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+            from benchmarks.service_smoke import summarize as service_summarize
+
+            details["service"] = service_summarize()
+        except Exception as exc:
+            details["service_error"] = repr(exc)[:200]
+
     print(json.dumps(details), file=sys.stderr, flush=True)
     if not metric_printed:
         raise SystemExit("no backend produced a timing")

@@ -1,0 +1,172 @@
+"""Index-service smoke + overhead harness.
+
+Two consumers:
+
+* ``make service-smoke`` / ``python benchmarks/service_smoke.py`` — the
+  CI gate: boot an :class:`IndexServer` on an ephemeral loopback port,
+  drive one epoch through 4 concurrent clients, assert every delivered
+  stream is bit-identical to the local sampler, and assert the metrics
+  endpoint reports the traffic (batches served per client, regen timer).
+  Exit 0 and one JSON line on success; raises loudly on any mismatch.
+
+* ``bench.py`` imports :func:`summarize` — the service-vs-local
+  per-batch overhead, measured by the same subtraction discipline as
+  benchmarks/stall_native.py: stream one epoch through the service and
+  compute the same epoch locally with the identical backend; the delta
+  divided by the batch count is the transport + framing + locking cost
+  per GET_BATCH.  The epoch regen itself is common to both arms and
+  cancels out of the per-batch figure.
+
+Loopback only: the point is the protocol's own cost, not the network's.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _service_epoch_ms(spec, *, batch: int, epoch: int, world: int,
+                      metrics=None):
+    """Wall ms to stream one full epoch to ``world`` concurrent clients,
+    plus the per-rank delivered arrays (for the parity assertion)."""
+    from partiallyshuffledistributedsampler_tpu.service import (
+        IndexServer,
+        ServiceIndexClient,
+    )
+
+    results: dict[int, np.ndarray] = {}
+    errors: list = []
+
+    with IndexServer(spec, metrics=metrics) as srv:
+        host, port = srv.address
+
+        def run(rank: int) -> None:
+            try:
+                with ServiceIndexClient((host, port), rank=rank,
+                                        batch=batch) as c:
+                    results[rank] = c.epoch_indices(epoch)
+            except BaseException as exc:  # surfaced by the caller
+                errors.append((rank, exc))
+
+        threads = [threading.Thread(target=run, args=(r,))
+                   for r in range(world)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall_ms = (time.perf_counter() - t0) * 1e3
+        report = srv.metrics.report()
+    if errors:
+        raise RuntimeError(f"service clients failed: {errors!r}")
+    return wall_ms, results, report
+
+
+def _local_epoch_ms(spec, *, epoch: int, world: int):
+    """Wall ms for the same per-rank streams computed in-process."""
+    t0 = time.perf_counter()
+    ref = {rank: spec.rank_indices(epoch, rank) for rank in range(world)}
+    return (time.perf_counter() - t0) * 1e3, ref
+
+
+def summarize(*, n: int = 200_000, window: int = 1024, batch: int = 8192,
+              world: int = 4, epoch: int = 1, backend: str = "cpu") -> dict:
+    """The bench.py tier: service-vs-local wall for one epoch and the
+    per-GET_BATCH overhead that difference implies."""
+    from partiallyshuffledistributedsampler_tpu.service import (
+        PartialShuffleSpec,
+        ServiceMetrics,
+    )
+
+    spec = PartialShuffleSpec.plain(n, window=window, seed=0, world=world,
+                                    backend=backend)
+    # local arm first: the service arm then regenerates the same epoch
+    # itself, so neither arm amortizes the other's regen
+    local_ms, ref = _local_epoch_ms(spec, epoch=epoch, world=world)
+    metrics = ServiceMetrics()
+    service_ms, got, report = _service_epoch_ms(
+        spec, batch=batch, epoch=epoch, world=world, metrics=metrics)
+    for rank in range(world):
+        if not np.array_equal(got[rank], ref[rank]):
+            raise AssertionError(f"service stream != local, rank {rank}")
+    batches = int(report["counters"].get("batches_served", 0))
+    return {
+        "n": n, "world": world, "transport_batch": batch,
+        "service_epoch_ms": round(service_ms, 3),
+        "local_epoch_ms": round(local_ms, 3),
+        "batches_served": batches,
+        "service_overhead_ms_per_batch": round(
+            max(0.0, service_ms - local_ms) / max(1, batches), 4),
+        "epoch_regen_ms": report["timers"].get("epoch_regen_ms"),
+        "stall": _service_stall(spec, batch=batch, world=world),
+    }
+
+
+def _service_stall(spec, *, batch: int, world: int) -> dict:
+    """One rank's service stream through the same ``StallProbe`` the
+    local loaders are measured with: how starved would a consumer doing
+    zero work be, and over how many batches."""
+    from partiallyshuffledistributedsampler_tpu.service import (
+        IndexServer,
+        ServiceIndexClient,
+    )
+    from partiallyshuffledistributedsampler_tpu.utils import StallProbe
+
+    with IndexServer(spec) as srv:
+        with ServiceIndexClient(srv.address, rank=0, batch=batch) as c:
+            probe = StallProbe(c.epoch_batches(2))
+            for _ in probe:
+                pass
+    return {
+        "batches": probe.batches,
+        "stall_fraction": round(probe.stall_fraction, 4),
+        "wait_ms_per_batch": round(
+            probe.wait_s * 1e3 / max(1, probe.batches), 4),
+    }
+
+
+def main() -> None:
+    """The `make service-smoke` gate: small config, hard assertions."""
+    from partiallyshuffledistributedsampler_tpu.service import (
+        PartialShuffleSpec,
+        ServiceMetrics,
+    )
+
+    world, batch, epoch = 4, 512, 2
+    spec = PartialShuffleSpec.plain(50_000, window=128, seed=0, world=world)
+    metrics = ServiceMetrics()
+    wall_ms, got, report = _service_epoch_ms(
+        spec, batch=batch, epoch=epoch, world=world, metrics=metrics)
+
+    _, ref = _local_epoch_ms(spec, epoch=epoch, world=world)
+    for rank in range(world):
+        assert np.array_equal(got[rank], ref[rank]), \
+            f"rank {rank}: served stream != local sampler stream"
+
+    # the metrics endpoint must account for exactly the traffic we drove
+    per_rank_batches = -(-len(ref[0]) // batch)
+    assert report["counters"]["batches_served"] == per_rank_batches * world, \
+        report["counters"]
+    for rank in range(world):
+        assert report["clients"][str(rank)]["batches_served"] \
+            == per_rank_batches, (rank, report["clients"])
+    assert "epoch_regen_ms" in report["timers"], report["timers"]
+
+    print(json.dumps({
+        "service_smoke": "ok", "world": world,
+        "per_rank_batches": per_rank_batches,
+        "wall_ms": round(wall_ms, 3),
+        "counters": report["counters"],
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -27,6 +27,10 @@ Public surface
   source partially shuffled by its own windowed permutation; stateless
   and random-access like every other stream here.
 * ``parallel`` — mesh-sharded regen with ICI seed agreement.
+* ``service`` — the shared index-serving daemon: one ``IndexServer`` owns
+  epoch state for a ``PartialShuffleSpec`` and streams per-rank index
+  batches to N ``ServiceIndexClient`` loader processes over loopback TCP
+  (backpressure, reconnect/resume, snapshots, metrics — docs/SERVICE.md).
 * ``enable_big_index_space()`` — opt into >=2^31-sample index spaces (x64).
 
 The normative permutation law lives in ``SPEC.md`` at the repo root.
@@ -56,7 +60,7 @@ def enable_big_index_space() -> None:
 
 def __getattr__(name):
     # Lazy subpackage access (torch / jax only imported when actually used).
-    if name in ("sampler", "parallel", "models", "utils"):
+    if name in ("sampler", "parallel", "models", "utils", "service"):
         import importlib
 
         return importlib.import_module(f".{name}", __name__)

@@ -177,6 +177,13 @@ class MixtureSpec:
     #: cap); bigger blocks fall back to the per-lane chained gathers
     _PACK_B_CAP = 2048
 
+    #: block-size cap for the packed [B] slot table: the prefix count
+    #: occupies bits 8..31 of the uint32, so any count >= 2^24 would wrap
+    #: into garbage lane parameters — a silently wrong stream, not a slow
+    #: one.  Blocks at or past the cap fall back to the chained
+    #: pattern+prefix gathers (bit-identical, just slower).
+    _PACK_SLOT_B_CAP = 1 << 24
+
     # ------------------------------------------------------------------ info
     @property
     def num_sources(self) -> int:
@@ -187,10 +194,14 @@ class MixtureSpec:
         evaluator's v1 (unrotated) lane parameters in ONE gather instead
         of a chained pattern+prefix pair (each full-width gather measured
         ~3x a whole 24-round bijection pass on the bench device).  None
-        when S >= 256 (the source id must fit the low byte)."""
+        when S >= 256 (the source id must fit the low byte) or when
+        ``block >= _PACK_SLOT_B_CAP`` (the prefix count must fit bits
+        8..31 — an uncapped pack would wrap and serve a silently wrong
+        stream)."""
         cached = getattr(self, "_packed_slot", None)
         if cached is None:
-            if self.num_sources >= 256:
+            if (self.num_sources >= 256
+                    or self.block >= self._PACK_SLOT_B_CAP):
                 return None
             t = np.arange(self.block)
             c_own = self.prefix[t, self.pattern]  # C_s(t) for s = pattern[t]

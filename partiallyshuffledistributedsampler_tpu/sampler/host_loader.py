@@ -35,7 +35,7 @@ from typing import Iterator, Optional
 
 import numpy as np
 
-from ..ops import core, ensure_index_backend, epoch_indices_host
+from ..ops import core, ensure_index_backend
 
 _SENTINEL = object()
 
@@ -78,6 +78,13 @@ class HostDataLoader:
     drop_last_batch: as in DeviceEpochIterator; False serves the trailing
         partial batch.
     device: target for ``jax.device_put`` (default: default device).
+    index_client: a ``service.ServiceIndexClient`` — fetch the epoch index
+        stream from a shared index-serving daemon instead of regenerating
+        it locally (docs/SERVICE.md).  The stream is bit-identical to the
+        local path by construction (the daemon evaluates the same
+        ``PartialShuffleSpec`` this loader builds), so checkpoints
+        interoperate; elastic ``layers`` are a local-sampler feature and
+        raise on the service path.
 
     The sampler kwargs (shuffle/drop_last/order_windows/partition/rounds)
     pass through to the index core unchanged.
@@ -100,6 +107,7 @@ class HostDataLoader:
         epoch_samples: Optional[int] = None,
         shard_sizes=None,
         within_shard_shuffle=True,
+        index_client=None,
         **kwargs,
     ) -> None:
         if mixture is not None and shard_sizes is not None:
@@ -215,6 +223,30 @@ class HostDataLoader:
         self.device = device
         self.kwargs = kwargs
         self.num_samples = num_samples
+        self.index_client = index_client
+        # ONE description of this loader's stream, shared verbatim with the
+        # index service (service/spec.py) — local regen and a daemon serving
+        # the same config cannot drift because both evaluate this object
+        from ..service.spec import PartialShuffleSpec
+
+        if self.mixture is not None:
+            self.stream_spec = PartialShuffleSpec.mixture(
+                self.mixture, seed=self.seed, world=self.world,
+                epoch_samples=self.epoch_samples,
+                backend=self.index_backend, **self.kwargs,
+            )
+        elif self.shard_sizes is not None:
+            self.stream_spec = PartialShuffleSpec.shard(
+                self.shard_sizes, window=self.window, seed=self.seed,
+                world=self.world,
+                within_shard_shuffle=self.within_shard_shuffle,
+                backend=self.index_backend, **self.kwargs,
+            )
+        else:
+            self.stream_spec = PartialShuffleSpec.plain(
+                self.n, window=self.window, seed=self.seed, world=self.world,
+                backend=self.index_backend, **self.kwargs,
+            )
         if self.shard_sizes is not None:
             # the per-epoch SAMPLE count follows the rank's shard draw
             self.steps_per_epoch: Optional[int] = None
@@ -292,7 +324,8 @@ class HostDataLoader:
         (epoch, layers): the documented shard-mode pattern calls
         ``epoch_steps(e)`` then ``epoch(e)``, and the streams are pure,
         so the second O(num_samples) regen+expansion would be pure
-        waste."""
+        waste.  Dropped once the epoch generator is exhausted (or via
+        :meth:`clear_cache`) so the array doesn't outlive its epoch."""
         key = (int(epoch),
                None if layers is None
                else tuple((int(w), int(c)) for w, c in layers))
@@ -304,7 +337,26 @@ class HostDataLoader:
         self._idx_cache = (key, idx)
         return idx
 
+    def clear_cache(self) -> None:
+        """Drop the one-entry epoch index cache now — for callers that
+        keep the loader alive between epochs and want the (potentially
+        hundreds of MB for shard-mode epochs) array reclaimed before the
+        next ``epoch()`` call.  Exhausting an epoch clears it too."""
+        self._idx_cache = None
+
     def _compute_epoch_indices(self, epoch: int, layers) -> np.ndarray:
+        if self.index_client is not None:
+            if layers is not None:
+                raise ValueError(
+                    "elastic layers are a local-sampler feature; the index "
+                    "service path does not serve remainder epochs"
+                )
+            return np.asarray(self.index_client.epoch_indices(epoch))
+        if layers is None:
+            # the shared stream description (service/spec.py) — the same
+            # object an IndexServer of this config evaluates
+            return np.asarray(self.stream_spec.rank_indices(epoch, self.rank))
+        # §6 elastic remainder epochs stay local-only
         if self.mixture is not None:
             return self._mixture_indices(epoch, layers)
         base = self._base_indices(epoch, layers)
@@ -321,11 +373,6 @@ class HostDataLoader:
         )
 
     def _base_indices(self, epoch: int, layers) -> np.ndarray:
-        if layers is None:
-            return epoch_indices_host(
-                self.index_backend, self.n, self.window, self.seed, epoch,
-                self.rank, self.world, **self.kwargs,
-            )
         from ..ops.cpu import elastic_indices_np
 
         return elastic_indices_np(
@@ -501,6 +548,13 @@ class HostDataLoader:
                 except queue.Empty:
                     break
             t.join(timeout=5.0)
+            # the epoch is over (exhausted or abandoned): the one-entry
+            # index cache has served its epoch_steps+epoch purpose and
+            # would otherwise pin the full epoch array (hundreds of MB for
+            # large shard-mode epochs) until the next epoch() call
+            cached = getattr(self, "_idx_cache", None)
+            if cached is not None and cached[1] is idx:
+                self._idx_cache = None
 
 
 class _ConcatView:

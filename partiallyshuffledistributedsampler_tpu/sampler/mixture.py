@@ -330,6 +330,16 @@ class PartialShuffleMixtureSampler(ChunkedIterMixin, _TorchSampler):
         self.epoch = e
         if self._elastic is not None:
             return  # remainder epoch regenerates on demand in __iter__
+        from .torch_shim import _AsyncRegen
+
+        if self._pending_epoch == e and self._pending is not None:
+            return  # this epoch's prefetch is already in flight
+        stale, self._pending = self._pending, None
+        self._pending_epoch = None
+        if isinstance(stale, _AsyncRegen):
+            # mirror of the single-source shim: retire a stale in-flight
+            # regen before spawning another (no thread accumulation)
+            stale.discard()
         if self.backend == "xla":
             self._pending = self._generate_device(e)
             self._pending_epoch = e
@@ -340,8 +350,6 @@ class PartialShuffleMixtureSampler(ChunkedIterMixin, _TorchSampler):
         else:
             # host prefetch, mirroring the single-source shim: regen on a
             # daemon thread so __iter__ finds the array ready
-            from .torch_shim import _AsyncRegen
-
             self._pending = _AsyncRegen(lambda e=e: self._generate_host(e))
             self._pending_epoch = e
 

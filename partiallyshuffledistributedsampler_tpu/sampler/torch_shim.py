@@ -118,6 +118,15 @@ class _AsyncRegen:
             raise self._exc
         return self._result
 
+    def discard(self) -> None:
+        """Retire the worker without consuming its result: join the
+        thread (numpy/native regens can't be interrupted mid-flight, but
+        joining bounds live threads at one) and swallow any exception —
+        nobody will ever read this regen."""
+        self._t.join()
+        self._result = None
+        self._exc = None
+
 
 def _elastic_layers_from_state(el):
     """Normalize a checkpoint's elastic field to [(world, consumed), ...].
@@ -344,6 +353,15 @@ class PartiallyShuffleDistributedSampler(ChunkedIterMixin, _TorchSampler):
         self.epoch = e
         if self._elastic is not None:
             return  # remainder epoch regenerates on demand in __iter__
+        if self._pending_epoch == e and self._pending is not None:
+            return  # this epoch's prefetch is already in flight
+        stale, self._pending = self._pending, None
+        self._pending_epoch = None
+        if isinstance(stale, _AsyncRegen):
+            # a different epoch's host regen is still running; retire it
+            # before spawning another — a set_epoch hammer loop must not
+            # accumulate one live thread per call
+            stale.discard()
         if self.backend == "xla":
             self._pending = self._generate_device(self.epoch)
             self._pending_epoch = self.epoch

@@ -1,0 +1,20 @@
+"""Shared index-serving service: one sampler daemon, many loader clients.
+
+The local samplers make every trainer host regenerate the full windowed
+permutation; this subsystem turns that into infrastructure (docs/SERVICE.md):
+:class:`IndexServer` owns one :class:`PartialShuffleSpec` (plain, mixture,
+or shard-mode), generates each epoch once through the existing backends,
+and streams disjoint per-rank index ranges to N
+:class:`ServiceIndexClient` s over loopback TCP — with backpressure,
+rank leases, reconnect/resume, snapshots, and metrics.
+"""
+
+from .client import (  # noqa: F401
+    ServiceError,
+    ServiceIndexClient,
+    ServiceUnavailable,
+)
+from .metrics import ServiceMetrics  # noqa: F401
+from .protocol import PROTOCOL_VERSION, ProtocolError  # noqa: F401
+from .server import IndexServer  # noqa: F401
+from .spec import PartialShuffleSpec  # noqa: F401

@@ -1,0 +1,308 @@
+"""`ServiceIndexClient`: the consumer side of the index service.
+
+A thin, synchronous client that claims a rank, streams its epoch's index
+batches, and survives server restarts: every request is idempotent (the
+server is a pure function of ``(epoch, seq)`` plus the spec), so the
+retry layer reconnects with exponential backoff + jitter and replays the
+cursor — the delivered index stream is exactly-once and bit-identical to
+a local sampler run no matter how many times the connection (or the
+server) died in between.
+
+Drop-in surfaces:
+
+* ``epoch_indices(epoch)`` → the rank's full epoch stream as one host
+  array — feed it anywhere a local ``epoch_indices`` result goes
+  (``HostDataLoader(..., index_client=client)`` does exactly this).
+* ``epoch_batches(epoch)`` → an iterator of index batches, resumable via
+  ``start_seq`` / ``state_dict()``; wrap it in
+  :class:`~..utils.stall_probe.StallProbe` to measure service-path
+  starvation the same way the local loaders are measured.
+"""
+
+from __future__ import annotations
+
+import random
+import socket
+import time
+from typing import Iterator, Optional
+
+import numpy as np
+
+from . import protocol as P
+from .metrics import ServiceMetrics
+
+#: ERROR codes that indicate a configuration/contract problem — retrying
+#: cannot fix them, so they raise immediately
+_FATAL_CODES = frozenset(
+    {"proto", "world", "spec", "batch", "bad_request", "unknown_type",
+     "protocol", "no_rank"}
+)
+
+
+class ServiceError(RuntimeError):
+    """Server answered ERROR; ``code`` carries the protocol error code."""
+
+    def __init__(self, code: str, detail: str = "") -> None:
+        super().__init__(f"[{code}] {detail}" if detail else code)
+        self.code = code
+
+
+class ServiceUnavailable(ServiceError):
+    """Retries exhausted without reaching a serving daemon."""
+
+    def __init__(self, detail: str) -> None:
+        super().__init__("unavailable", detail)
+
+
+def _parse_address(address):
+    if isinstance(address, (tuple, list)):
+        host, port = address
+        return str(host), int(port)
+    host, _, port = str(address).rpartition(":")
+    return host or "127.0.0.1", int(port)
+
+
+class ServiceIndexClient:
+    """One rank's view of an :class:`~.server.IndexServer`.
+
+    address:     ``(host, port)`` or ``"host:port"``.
+    rank:        the rank to claim; ``None`` auto-claims the lowest free
+                 rank (the server assigns; read ``client.rank`` after).
+    batch:       transport batch size (indices per GET_BATCH) — a wire
+                 chunking knob, independent of the training batch size.
+    spec:        optional :class:`~.spec.PartialShuffleSpec`; when given,
+                 HELLO carries its fingerprint and the server refuses a
+                 mismatch (otherwise the client trusts the server and
+                 exposes the served config as ``client.spec_wire``).
+    timeout:     per-request socket timeout (seconds).
+    reconnect_timeout: total time the retry layer keeps trying to reach a
+                 server before raising :class:`ServiceUnavailable`.
+    backoff_base/backoff_max: exponential-backoff bounds; each sleep is
+                 jittered to ``[0.5, 1.5)`` of the nominal value so N
+                 clients dropped by one restart don't reconnect in
+                 lockstep.
+    """
+
+    def __init__(
+        self,
+        address,
+        *,
+        rank: Optional[int] = None,
+        batch: int = 65536,
+        spec=None,
+        timeout: float = 10.0,
+        reconnect_timeout: float = 30.0,
+        backoff_base: float = 0.05,
+        backoff_max: float = 2.0,
+        metrics: Optional[ServiceMetrics] = None,
+    ) -> None:
+        self.address = _parse_address(address)
+        self.rank = None if rank is None else int(rank)
+        self.batch = int(batch)
+        if self.batch < 1:
+            raise ValueError(f"batch must be >= 1, got {batch}")
+        self.expected_spec = spec
+        self.timeout = float(timeout)
+        self.reconnect_timeout = float(reconnect_timeout)
+        self.backoff_base = float(backoff_base)
+        self.backoff_max = float(backoff_max)
+        self.metrics = metrics if metrics is not None else ServiceMetrics()
+        self.spec_wire: Optional[dict] = None
+        self.server_epoch: Optional[int] = None
+        self._sock: Optional[socket.socket] = None
+        self._cursor = {"epoch": None, "seq": 0}  # next undelivered batch
+
+    # ----------------------------------------------------------- connection
+    def _connect(self) -> None:
+        sock = socket.create_connection(self.address, timeout=self.timeout)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        sock.settimeout(self.timeout)
+        hello = {
+            "proto": P.PROTOCOL_VERSION,
+            "rank": -1 if self.rank is None else self.rank,
+            "batch": self.batch,
+        }
+        if self.expected_spec is not None:
+            hello["world"] = self.expected_spec.world
+            hello["spec_fingerprint"] = self.expected_spec.fingerprint()
+        try:
+            P.send_msg(sock, P.MSG_HELLO, hello)
+            msg, header, _ = P.recv_msg(sock)
+        except BaseException:
+            sock.close()
+            raise
+        if msg == P.MSG_ERROR:
+            sock.close()
+            raise ServiceError(header.get("code", "error"),
+                               header.get("detail", ""))
+        if msg != P.MSG_WELCOME:
+            sock.close()
+            raise P.ProtocolError(
+                f"expected WELCOME, got {P.msg_name(msg)}"
+            )
+        self.rank = int(header["rank"])
+        self.spec_wire = header.get("spec")
+        self.server_epoch = header.get("epoch")
+        self._sock = sock
+
+    def _ensure_connected(self) -> None:
+        if self._sock is None:
+            self._connect()
+
+    def close(self) -> None:
+        sock, self._sock = self._sock, None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def __enter__(self) -> "ServiceIndexClient":
+        self._ensure_connected()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ---------------------------------------------------------------- retry
+    def _rpc(self, msg_type: int, header: dict):
+        """One request → reply, retrying across connection loss.
+
+        Every message this client sends is idempotent, so a reconnect +
+        replay can never double-deliver; ``throttle`` errors sleep the
+        server-suggested interval and retry on the live connection."""
+        deadline = time.monotonic() + self.reconnect_timeout
+        attempt = 0
+        while True:
+            try:
+                try:
+                    self._ensure_connected()
+                except ServiceError as exc:
+                    if exc.code not in ("rank_taken", "not_owner"):
+                        raise
+                    # our own just-dropped lease may not have been released
+                    # yet (the server notices the dead conn asynchronously);
+                    # back off and re-HELLO like any other lease race
+                    if time.monotonic() > deadline:
+                        raise
+                    time.sleep(self.backoff_base)
+                    continue
+                if "rank" in header:
+                    # the lazy connect (or a re-HELLO after lease loss) is
+                    # what assigns auto-claimed ranks — stamp the current
+                    # one on every attempt
+                    header["rank"] = self.rank
+                P.send_msg(self._sock, msg_type, header)
+                reply, rheader, payload = P.recv_msg(self._sock)
+            except (ConnectionError, socket.timeout, OSError,
+                    P.ProtocolError) as exc:
+                self.close()
+                attempt += 1
+                self.metrics.inc("reconnects", self.rank)
+                delay = min(self.backoff_max,
+                            self.backoff_base * (2 ** (attempt - 1)))
+                delay *= 0.5 + random.random()  # jitter: desynchronize herds
+                if time.monotonic() + delay > deadline:
+                    raise ServiceUnavailable(
+                        f"no server at {self.address} after {attempt} "
+                        f"attempts ({exc!r})"
+                    ) from None
+                time.sleep(delay)
+                continue
+            if reply == P.MSG_ERROR:
+                code = rheader.get("code", "error")
+                if code == "throttle":
+                    self.metrics.inc("throttled", self.rank)
+                    time.sleep(float(rheader.get("retry_ms", 20)) / 1e3)
+                    continue
+                if code == "not_owner" or code == "rank_taken":
+                    # lease lost (eviction or a racing claimant): re-HELLO
+                    # once the stale claimant's lease clears; fatal only if
+                    # it never does within the deadline
+                    self.close()
+                    if time.monotonic() > deadline:
+                        raise ServiceError(code, rheader.get("detail", ""))
+                    time.sleep(self.backoff_base)
+                    continue
+                raise ServiceError(code, rheader.get("detail", ""))
+            return reply, rheader, payload
+
+    # ------------------------------------------------------------- batches
+    def epoch_batches(self, epoch: int, *,
+                      start_seq: int = 0) -> Iterator[np.ndarray]:
+        """Stream the rank's batches for ``epoch`` from ``start_seq`` on.
+
+        Each ``GET_BATCH`` acks everything before it (the batches this
+        generator already yielded), keeping the in-flight window at one —
+        comfortably inside any server's ``max_inflight``."""
+        epoch, seq = int(epoch), int(start_seq)
+        self._cursor = {"epoch": epoch, "seq": seq}
+        while True:
+            reply, header, payload = self._rpc(P.MSG_GET_BATCH, {
+                "rank": self.rank, "epoch": epoch, "seq": seq,
+                "ack": seq - 1,
+            })
+            if reply != P.MSG_BATCH:
+                raise P.ProtocolError(
+                    f"expected BATCH, got {P.msg_name(reply)}"
+                )
+            if header.get("eof"):
+                return
+            arr = P.decode_indices(header, payload)
+            self.metrics.inc("batches_served", self.rank)
+            # advance BEFORE yielding: once the consumer holds the batch it
+            # counts as delivered, so a state_dict() taken between batches
+            # resumes at the next one (exactly-once, not at-least-once)
+            seq += 1
+            self._cursor = {"epoch": epoch, "seq": seq}
+            yield arr
+
+    def epoch_indices(self, epoch: int) -> np.ndarray:
+        """The rank's full epoch stream as one array — the drop-in for a
+        local sampler's ``epoch_indices`` (``HostDataLoader`` consumes
+        this when constructed with ``index_client=``)."""
+        parts = list(self.epoch_batches(epoch))
+        if not parts:
+            return np.empty(0, dtype=np.int64)
+        return np.concatenate(parts)
+
+    # ----------------------------------------------------------- control ops
+    def set_epoch(self, epoch: int) -> int:
+        _, header, _ = self._rpc(P.MSG_SET_EPOCH, {"epoch": int(epoch)})
+        self.server_epoch = int(header["epoch"])
+        return self.server_epoch
+
+    def heartbeat(self) -> None:
+        self._rpc(P.MSG_HEARTBEAT, {"rank": self.rank})
+
+    def snapshot(self) -> dict:
+        _, header, _ = self._rpc(P.MSG_SNAPSHOT, {})
+        return header["state"]
+
+    def server_metrics(self) -> dict:
+        _, header, _ = self._rpc(P.MSG_METRICS, {})
+        return header["report"]
+
+    # ---------------------------------------------------------- checkpoint
+    def state_dict(self) -> dict:
+        """The resume cursor: drop it into ``utils/checkpoint`` alongside
+        the trainer state to continue a killed *client* exactly-once."""
+        return {"kind": "service_client", "rank": self.rank,
+                "epoch": self._cursor["epoch"], "seq": self._cursor["seq"]}
+
+    def load_state_dict(self, state: dict) -> None:
+        if state.get("kind") != "service_client":
+            raise ValueError(
+                f"state kind {state.get('kind')!r} is not a service_client "
+                "checkpoint"
+            )
+        self.rank = None if state["rank"] is None else int(state["rank"])
+        self._cursor = {"epoch": state["epoch"], "seq": int(state["seq"])}
+
+    def resume_batches(self) -> Iterator[np.ndarray]:
+        """Continue the loaded/current cursor's epoch from where it left."""
+        if self._cursor["epoch"] is None:
+            raise RuntimeError("no cursor to resume; call epoch_batches or "
+                               "load_state_dict first")
+        return self.epoch_batches(self._cursor["epoch"],
+                                  start_seq=self._cursor["seq"])

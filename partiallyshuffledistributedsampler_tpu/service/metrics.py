@@ -1,0 +1,68 @@
+"""Service observability: per-client counters over the shared registry.
+
+The daemon's numbers ride the same :class:`~..utils.metrics.MetricsRegistry`
+surface the rest of the framework exports through, so ``bench.py``, the
+``make service-smoke`` gate and an operator ``METRICS`` poll all read one
+report produced one way.  Counter vocabulary (the ISSUE's metric set):
+
+* ``batches_served``   — BATCH replies carrying indices
+* ``resends``          — BATCH replies for a seq already served to that
+                         rank (a reconnected client replaying its cursor)
+* ``reconnects``       — HELLOs re-claiming a rank this server already
+                         served (client came back after a drop)
+* ``heartbeat_gaps``   — gaps between a client's messages that exceeded
+                         the lease timeout but the client returned
+* ``evictions``        — rank leases revoked for missed heartbeats
+* ``throttled``        — GET_BATCHs refused by backpressure
+* ``epoch_regen_ms``   — timer: per-(epoch, rank) index generation
+
+Per-client copies of the counters live under ``clients[rank]``; the
+registry holds the totals.  The epoch regen timer is the same
+:class:`RegenTimer` every sampler uses, so "epoch regen ms" means the
+same thing here as in a local training loop.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..utils.metrics import MetricsRegistry
+
+#: counter names with a per-client breakdown
+_PER_CLIENT = (
+    "batches_served", "resends", "reconnects", "heartbeat_gaps", "evictions",
+    "throttled",
+)
+
+
+class ServiceMetrics:
+    """Counters for one daemon (or one client, with the same vocabulary).
+
+    ``registry`` defaults to a private :class:`MetricsRegistry`; pass a
+    shared one to fold several daemons into one report."""
+
+    def __init__(self, registry: MetricsRegistry | None = None) -> None:
+        self.registry = registry if registry is not None else MetricsRegistry()
+        self._lock = threading.Lock()
+        self.clients: dict[int, dict[str, int]] = {}
+
+    def inc(self, name: str, rank: int | None = None, value: int = 1) -> None:
+        self.registry.inc(name, value)
+        if rank is not None and name in _PER_CLIENT:
+            with self._lock:
+                per = self.clients.setdefault(
+                    int(rank), {k: 0 for k in _PER_CLIENT}
+                )
+                per[name] += value
+
+    @property
+    def regen_timer(self):
+        return self.registry.timer("epoch_regen_ms")
+
+    def report(self) -> dict:
+        out = self.registry.report()
+        with self._lock:
+            out["clients"] = {
+                str(r): dict(c) for r, c in sorted(self.clients.items())
+            }
+        return out

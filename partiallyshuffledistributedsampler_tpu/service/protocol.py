@@ -1,0 +1,142 @@
+"""Wire protocol of the index service: length-prefixed binary framing.
+
+One frame on the wire is
+
+    u32  length of everything after this field (big-endian)
+    u8   message type (the MSG_* constants)
+    u32  header length H (big-endian)
+    H bytes of UTF-8 JSON header
+    remaining bytes: raw payload (index batches ride here as native
+                     numpy bytes; ``header["dtype"]`` names the layout)
+
+The header carries the small structured fields (rank, epoch, seq, error
+codes); the payload is reserved for bulk index data so a batch costs one
+JSON parse of a ~100-byte header, never a JSON encode of the indices.
+
+Versioning: ``HELLO`` carries ``proto=PROTOCOL_VERSION`` and the server
+refuses mismatches up front, so a framing change bumps the constant and
+old clients fail at the handshake instead of mid-epoch.  Message types
+are stable small ints — new types may be added within a version; unknown
+types draw an ``ERROR`` reply, not a closed connection.
+
+Request → reply pairs (client sends left, server answers right):
+
+    HELLO      → WELCOME | ERROR     claim a rank (``rank=-1`` auto-claims)
+    GET_BATCH  → BATCH | ERROR       one batch of the rank's epoch stream
+    SET_EPOCH  → OK | ERROR          advance the served epoch
+    SNAPSHOT   → SNAPSHOT_STATE      server state (restart/restore dict)
+    HEARTBEAT  → OK                  keep the rank lease alive
+    METRICS    → METRICS_REPORT      the daemon's counters/timers
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+
+import numpy as np
+
+#: bump on any framing/semantics change; HELLO negotiates it
+PROTOCOL_VERSION = 1
+
+#: frames above this are a protocol violation (a corrupt length prefix
+#: must not make the reader try to allocate gigabytes)
+MAX_FRAME = 1 << 26  # 64 MiB
+
+MSG_HELLO = 1
+MSG_WELCOME = 2
+MSG_GET_BATCH = 3
+MSG_BATCH = 4
+MSG_SET_EPOCH = 5
+MSG_SNAPSHOT = 6
+MSG_SNAPSHOT_STATE = 7
+MSG_HEARTBEAT = 8
+MSG_OK = 9
+MSG_ERROR = 10
+MSG_METRICS = 11
+MSG_METRICS_REPORT = 12
+
+_NAMES = {
+    v: k[len("MSG_"):] for k, v in list(globals().items())
+    if k.startswith("MSG_")
+}
+
+
+def msg_name(msg_type: int) -> str:
+    return _NAMES.get(msg_type, f"UNKNOWN({msg_type})")
+
+
+class ProtocolError(RuntimeError):
+    """Malformed frame or out-of-contract message sequence."""
+
+
+def pack(msg_type: int, header: dict, payload: bytes = b"") -> bytes:
+    h = json.dumps(header, separators=(",", ":")).encode()
+    body_len = 1 + 4 + len(h) + len(payload)
+    if body_len > MAX_FRAME:
+        raise ProtocolError(f"frame of {body_len} bytes exceeds {MAX_FRAME}")
+    return struct.pack("!IBI", body_len, msg_type, len(h)) + h + payload
+
+
+def send_msg(sock: socket.socket, msg_type: int, header: dict,
+             payload: bytes = b"") -> None:
+    sock.sendall(pack(msg_type, header, payload))
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed mid-frame"
+                                  if buf or n else "peer closed")
+        buf += chunk
+    return bytes(buf)
+
+
+def recv_msg(sock: socket.socket):
+    """Read one frame → ``(msg_type, header, payload)``.
+
+    Raises ``ConnectionError`` on a clean or mid-frame close (the retry
+    layer's signal to reconnect) and :class:`ProtocolError` on a frame
+    that cannot be parsed (never retried — the peer is broken)."""
+    (body_len,) = struct.unpack("!I", _recv_exact(sock, 4))
+    if not 5 <= body_len <= MAX_FRAME:
+        raise ProtocolError(f"frame length {body_len} outside [5, {MAX_FRAME}]")
+    body = _recv_exact(sock, body_len)
+    msg_type, hlen = struct.unpack("!BI", body[:5])
+    if hlen > body_len - 5:
+        raise ProtocolError(f"header length {hlen} overruns frame {body_len}")
+    try:
+        header = json.loads(body[5:5 + hlen].decode())
+    except (UnicodeDecodeError, json.JSONDecodeError) as exc:
+        raise ProtocolError(f"unparseable header: {exc}") from None
+    if not isinstance(header, dict):
+        raise ProtocolError(f"header must be a JSON object, got "
+                            f"{type(header).__name__}")
+    return msg_type, header, body[5 + hlen:]
+
+
+# ------------------------------------------------------- index batch codec
+def encode_indices(arr: np.ndarray):
+    """``(header_fields, payload)`` for an index batch: raw bytes plus the
+    dtype string (with byte order) the receiver rebuilds from."""
+    a = np.ascontiguousarray(arr)
+    return {"dtype": a.dtype.str, "count": int(a.shape[0])}, a.tobytes()
+
+
+def decode_indices(header: dict, payload: bytes) -> np.ndarray:
+    try:
+        dtype = np.dtype(header["dtype"])
+    except (KeyError, TypeError, ValueError) as exc:
+        raise ProtocolError(f"bad BATCH dtype: {exc}") from None
+    count = int(header.get("count", -1))
+    if dtype.itemsize * max(count, 0) != len(payload):
+        raise ProtocolError(
+            f"BATCH payload is {len(payload)} bytes; header promises "
+            f"{count} x {dtype}"
+        )
+    arr = np.frombuffer(payload, dtype=dtype)
+    arr.setflags(write=False)  # frombuffer views are read-only anyway
+    return arr

@@ -1,0 +1,489 @@
+"""The index-serving daemon: one process owns epoch state, N clients stream.
+
+``IndexServer`` owns exactly one :class:`~.spec.PartialShuffleSpec` and
+serves its per-rank epoch streams over loopback TCP (the :mod:`.protocol`
+framing).  Design points, in the order they matter:
+
+* **One generation per (epoch, rank).**  A rank's stream is generated
+  once via the spec's backend (cpu/native/xla), cached read-only, and
+  every (re)connected client of that rank replays from the cache — the
+  redundant per-host regen the local samplers do N times collapses to
+  one, and the regen latency is timed into ``epoch_regen_ms``.
+* **Client-driven cursors → exactly-once.**  ``GET_BATCH`` names an
+  explicit ``(epoch, seq)``; the server is a pure function of that name
+  plus the spec, so a client that reconnects after a server restart and
+  re-requests its cursor gets bit-identical bytes (counted as a
+  ``resend`` when the seq was already served).
+* **Backpressure.**  A rank may run at most ``max_inflight`` batches
+  past its acked cursor; beyond that ``GET_BATCH`` draws an
+  ``ERROR(code='throttle', retry_ms=...)`` instead of queueing unbounded
+  frames into a slow consumer's socket.
+* **Leases, not registrations.**  A rank is leased to one connection;
+  the lease expires after ``heartbeat_timeout`` seconds of silence
+  (evicted lazily on claim *and* by the accept-loop sweep, which also
+  closes the idle socket).  A dropped connection releases its lease
+  immediately, so crash-reconnect never waits out the timeout.
+* **Snapshots.**  Server state — spec wire form, current epoch, per-rank
+  cursors — persists through ``utils/checkpoint``'s atomic-json helpers
+  to ``snapshot_path`` (on SET_EPOCH, lease changes, every
+  ``snapshot_interval`` batches, and at ``stop()``); a restarted server
+  resumes from it.  Correctness does not depend on the snapshot (streams
+  are pure), it restores the *operational* state: the served epoch and
+  where each client was.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+import time
+from collections import OrderedDict
+from typing import Optional
+
+from ..utils.checkpoint import load_sampler_state, save_sampler_state
+from . import protocol as P
+from .metrics import ServiceMetrics
+from .spec import PartialShuffleSpec
+
+SNAPSHOT_KIND = "index_service"
+
+
+class IndexServer:
+    """Threaded loopback daemon serving one spec's index streams.
+
+        spec = PartialShuffleSpec.plain(n, window=8192, world=4)
+        with IndexServer(spec, port=0) as srv:   # ephemeral port
+            addr = srv.address                   # (host, port)
+            ...
+
+    One thread accepts, one thread per connection serves; all daemonic.
+    ``max_inflight`` bounds un-acked batches per rank; ``heartbeat_timeout``
+    bounds how long a silent connection holds its rank lease."""
+
+    def __init__(
+        self,
+        spec: PartialShuffleSpec,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        *,
+        max_inflight: int = 8,
+        heartbeat_timeout: float = 30.0,
+        snapshot_path: Optional[str] = None,
+        snapshot_interval: int = 64,
+        max_cached_arrays: Optional[int] = None,
+        metrics: Optional[ServiceMetrics] = None,
+    ) -> None:
+        if max_inflight < 1:
+            raise ValueError(f"max_inflight must be >= 1, got {max_inflight}")
+        self.spec = spec
+        self.host, self.port = host, int(port)
+        self.max_inflight = int(max_inflight)
+        self.heartbeat_timeout = float(heartbeat_timeout)
+        self.snapshot_path = snapshot_path
+        self.snapshot_interval = max(1, int(snapshot_interval))
+        # current epoch + one behind: a client finishing epoch e while
+        # another already moved to e+1 must not thrash regeneration
+        self._max_cached = (
+            2 * spec.world if max_cached_arrays is None
+            else max(1, int(max_cached_arrays))
+        )
+        self.metrics = metrics if metrics is not None else ServiceMetrics()
+        self.epoch = 0
+        self._lock = threading.Lock()          # leases / cursors / epoch
+        self._gen_lock = threading.Lock()      # the (epoch, rank) cache
+        self._cache: OrderedDict[tuple, object] = OrderedDict()
+        #: rank -> {"owner": conn_id|None, "last_seen": t, "batch": int}
+        self._leases: dict[int, dict] = {}
+        #: rank -> {"epoch": e, "acked": int, "hi": int} (hi = highest
+        #: seq ever served; a request at or below it is a resend)
+        self._cursors: dict[int, dict] = {}
+        self._listener: Optional[socket.socket] = None
+        self._threads: list[threading.Thread] = []
+        self._conn_socks: dict[int, socket.socket] = {}
+        self._next_conn_id = 0
+        self._unsnapshotted = 0
+        self._stop = threading.Event()
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> tuple[str, int]:
+        """Bind, restore any snapshot, and begin accepting.  Returns the
+        bound ``(host, port)`` — pass ``port=0`` for an ephemeral port."""
+        if self._listener is not None:
+            raise RuntimeError("server already started")
+        if self.snapshot_path and os.path.exists(self.snapshot_path):
+            self._restore(load_sampler_state(self.snapshot_path))
+        ls = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        ls.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        ls.bind((self.host, self.port))
+        ls.listen(128)
+        ls.settimeout(0.2)  # the accept loop doubles as the lease sweeper
+        self.host, self.port = ls.getsockname()[:2]
+        self._listener = ls
+        t = threading.Thread(target=self._accept_loop, daemon=True,
+                             name="psds-service-accept")
+        t.start()
+        self._threads.append(t)
+        return self.host, self.port
+
+    @property
+    def address(self) -> tuple[str, int]:
+        return self.host, self.port
+
+    def stop(self) -> None:
+        """Stop accepting, drop every connection, persist a snapshot."""
+        self._stop.set()
+        ls, self._listener = self._listener, None
+        if ls is not None:
+            try:
+                ls.close()
+            except OSError:
+                pass
+        with self._lock:
+            socks = list(self._conn_socks.values())
+        for s in socks:
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
+        for t in self._threads:
+            t.join(timeout=5.0)
+        self._threads.clear()
+        self._write_snapshot(force=True)
+
+    def __enter__(self) -> "IndexServer":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # ------------------------------------------------------------- snapshot
+    def _state_dict(self) -> dict:
+        with self._lock:
+            return {
+                "kind": SNAPSHOT_KIND,
+                "proto": P.PROTOCOL_VERSION,
+                "spec": self.spec.to_wire(),
+                "epoch": self.epoch,
+                "cursors": {
+                    str(r): dict(c) for r, c in self._cursors.items()
+                },
+            }
+
+    def _restore(self, state: dict) -> None:
+        if state.get("kind") != SNAPSHOT_KIND:
+            raise ValueError(
+                f"snapshot kind {state.get('kind')!r} is not a "
+                f"{SNAPSHOT_KIND!r} snapshot"
+            )
+        theirs = PartialShuffleSpec.from_wire(state["spec"],
+                                              backend=self.spec.backend)
+        if theirs.fingerprint() != self.spec.fingerprint():
+            raise ValueError(
+                "snapshot was written by a server with a different stream "
+                f"spec: {theirs.fingerprint()} != {self.spec.fingerprint()}; "
+                "serving it would hand clients a different permutation"
+            )
+        with self._lock:
+            self.epoch = int(state.get("epoch", 0))
+            self._cursors = {
+                int(r): {"epoch": int(c["epoch"]), "acked": int(c["acked"]),
+                         "hi": int(c["hi"])}
+                for r, c in state.get("cursors", {}).items()
+            }
+
+    def _write_snapshot(self, force: bool = False) -> None:
+        if not self.snapshot_path:
+            return
+        with self._lock:
+            self._unsnapshotted += 1
+            if not force and self._unsnapshotted < self.snapshot_interval:
+                return
+            self._unsnapshotted = 0
+        save_sampler_state(self.snapshot_path, self._state_dict())
+
+    # ------------------------------------------------------------ the cache
+    def _rank_array(self, epoch: int, rank: int):
+        key = (int(epoch), int(rank))
+        with self._gen_lock:
+            arr = self._cache.get(key)
+            if arr is not None:
+                self._cache.move_to_end(key)
+                return arr
+            with self.metrics.regen_timer.measure():
+                arr = self.spec.rank_indices(epoch, rank)
+            arr.setflags(write=False)
+            self._cache[key] = arr
+            while len(self._cache) > self._max_cached:
+                self._cache.popitem(last=False)
+            return arr
+
+    # --------------------------------------------------------------- accept
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            ls = self._listener
+            if ls is None:
+                return
+            try:
+                sock, _addr = ls.accept()
+            except socket.timeout:
+                self._sweep_leases()
+                continue
+            except OSError:
+                return  # listener closed by stop()
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._lock:
+                conn_id = self._next_conn_id
+                self._next_conn_id += 1
+                self._conn_socks[conn_id] = sock
+            t = threading.Thread(
+                target=self._serve_conn, args=(sock, conn_id), daemon=True,
+                name=f"psds-service-conn-{conn_id}",
+            )
+            t.start()
+            self._threads.append(t)
+
+    def _sweep_leases(self) -> None:
+        """Evict ranks whose connection went silent past the lease timeout
+        and close their sockets (frees the rank AND unblocks the reader)."""
+        now = time.monotonic()
+        to_close = []
+        with self._lock:
+            for rank, lease in self._leases.items():
+                owner = lease.get("owner")
+                if owner is None:
+                    continue
+                if now - lease["last_seen"] > self.heartbeat_timeout:
+                    lease["owner"] = None
+                    self.metrics.inc("evictions", rank)
+                    sock = self._conn_socks.get(owner)
+                    if sock is not None:
+                        to_close.append(sock)
+        for sock in to_close:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    # ------------------------------------------------------- per-connection
+    def _serve_conn(self, sock: socket.socket, conn_id: int) -> None:
+        try:
+            while not self._stop.is_set():
+                try:
+                    msg, header, payload = P.recv_msg(sock)
+                except P.ProtocolError as exc:
+                    # best-effort complaint, then drop the broken peer
+                    try:
+                        P.send_msg(sock, P.MSG_ERROR,
+                                   {"code": "protocol", "detail": str(exc)})
+                    except OSError:
+                        pass
+                    return
+                try:
+                    self._dispatch(sock, conn_id, msg, header, payload)
+                except OSError:
+                    return  # peer vanished mid-reply
+        except (ConnectionError, OSError):
+            return
+        finally:
+            self._release_conn(conn_id)
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _release_conn(self, conn_id: int) -> None:
+        """A closed connection releases its leases at once — a crashed
+        client's replacement must not wait out the heartbeat timeout."""
+        with self._lock:
+            self._conn_socks.pop(conn_id, None)
+            for lease in self._leases.values():
+                if lease.get("owner") == conn_id:
+                    lease["owner"] = None
+
+    def _touch(self, rank: int, lease: dict) -> None:
+        now = time.monotonic()
+        if now - lease["last_seen"] > self.heartbeat_timeout:
+            # the client went silent past the lease but came back before
+            # anything evicted it — a heartbeat gap worth counting
+            self.metrics.inc("heartbeat_gaps", rank)
+        lease["last_seen"] = now
+
+    def _dispatch(self, sock, conn_id, msg, header, payload) -> None:
+        if msg == P.MSG_HELLO:
+            self._on_hello(sock, conn_id, header)
+        elif msg == P.MSG_GET_BATCH:
+            self._on_get_batch(sock, conn_id, header)
+        elif msg == P.MSG_SET_EPOCH:
+            with self._lock:
+                self.epoch = int(header.get("epoch", 0))
+            self._write_snapshot(force=True)
+            P.send_msg(sock, P.MSG_OK, {"epoch": self.epoch})
+        elif msg == P.MSG_HEARTBEAT:
+            rank = header.get("rank")
+            with self._lock:
+                lease = self._leases.get(int(rank)) if rank is not None \
+                    else None
+                if lease is not None and lease.get("owner") == conn_id:
+                    self._touch(int(rank), lease)
+            P.send_msg(sock, P.MSG_OK, {})
+        elif msg == P.MSG_SNAPSHOT:
+            self._write_snapshot(force=True)
+            P.send_msg(sock, P.MSG_SNAPSHOT_STATE,
+                       {"state": self._state_dict()})
+        elif msg == P.MSG_METRICS:
+            P.send_msg(sock, P.MSG_METRICS_REPORT,
+                       {"report": self.metrics.report()})
+        else:
+            P.send_msg(sock, P.MSG_ERROR, {
+                "code": "unknown_type",
+                "detail": f"message type {P.msg_name(msg)} not served",
+            })
+
+    # ---------------------------------------------------------------- HELLO
+    def _on_hello(self, sock, conn_id, header) -> None:
+        proto = header.get("proto")
+        if proto != P.PROTOCOL_VERSION:
+            P.send_msg(sock, P.MSG_ERROR, {
+                "code": "proto",
+                "detail": f"server speaks protocol {P.PROTOCOL_VERSION}, "
+                          f"client sent {proto!r}",
+            })
+            return
+        world = header.get("world")
+        if world is not None and int(world) != self.spec.world:
+            P.send_msg(sock, P.MSG_ERROR, {
+                "code": "world",
+                "detail": f"server world is {self.spec.world}, client "
+                          f"expects {world}",
+            })
+            return
+        fp = header.get("spec_fingerprint")
+        if fp is not None and fp != self.spec.fingerprint():
+            P.send_msg(sock, P.MSG_ERROR, {
+                "code": "spec",
+                "detail": "client and server stream specs differ; refusing "
+                          "to serve a different permutation than requested",
+            })
+            return
+        batch = int(header.get("batch", 0))
+        if batch < 1:
+            P.send_msg(sock, P.MSG_ERROR,
+                       {"code": "batch", "detail": f"batch must be >= 1, "
+                                                   f"got {batch}"})
+            return
+        want = header.get("rank", -1)
+        want = -1 if want is None else int(want)
+        now = time.monotonic()
+        with self._lock:
+            rank = self._claim_rank(want, conn_id, now)
+            if rank is None:
+                code = "rank_taken" if 0 <= want < self.spec.world \
+                    else "no_rank"
+                P.send_msg(sock, P.MSG_ERROR, {
+                    "code": code,
+                    "detail": f"rank {want} is live-leased" if code ==
+                              "rank_taken" else
+                              f"all {self.spec.world} ranks are live-leased",
+                })
+                return
+            self._leases[rank]["batch"] = batch
+            if rank in self._cursors:
+                self.metrics.inc("reconnects", rank)
+            epoch = self.epoch
+        self._write_snapshot()
+        P.send_msg(sock, P.MSG_WELCOME, {
+            "proto": P.PROTOCOL_VERSION,
+            "rank": rank,
+            "world": self.spec.world,
+            "epoch": epoch,
+            "spec": self.spec.to_wire(),
+        })
+
+    def _claim_rank(self, want: int, conn_id: int, now: float):
+        """Grant ``want`` (or the lowest free rank for -1).  Called under
+        ``self._lock``.  A stale live lease is evicted on the spot."""
+        candidates = ([want] if want >= 0 else range(self.spec.world))
+        for rank in candidates:
+            if not 0 <= rank < self.spec.world:
+                return None
+            lease = self._leases.get(rank)
+            if lease is not None and lease.get("owner") is not None:
+                if now - lease["last_seen"] <= self.heartbeat_timeout:
+                    continue  # genuinely live
+                lease["owner"] = None
+                self.metrics.inc("evictions", rank)
+            self._leases[rank] = {"owner": conn_id, "last_seen": now,
+                                  "batch": self._leases.get(rank, {}).get(
+                                      "batch", 0)}
+            return rank
+        return None
+
+    # ------------------------------------------------------------ GET_BATCH
+    def _on_get_batch(self, sock, conn_id, header) -> None:
+        try:
+            rank = int(header["rank"])
+            epoch = int(header["epoch"])
+            seq = int(header["seq"])
+        except (KeyError, TypeError, ValueError):
+            P.send_msg(sock, P.MSG_ERROR,
+                       {"code": "bad_request",
+                        "detail": "GET_BATCH needs rank/epoch/seq ints"})
+            return
+        if seq < 0:
+            P.send_msg(sock, P.MSG_ERROR,
+                       {"code": "bad_request", "detail": f"seq {seq} < 0"})
+            return
+        with self._lock:
+            lease = self._leases.get(rank)
+            if lease is None or lease.get("owner") != conn_id:
+                P.send_msg(sock, P.MSG_ERROR, {
+                    "code": "not_owner",
+                    "detail": f"rank {rank} is not leased to this "
+                              "connection; HELLO first",
+                })
+                return
+            self._touch(rank, lease)
+            batch = lease["batch"]
+            cur = self._cursors.get(rank)
+            if cur is None or cur["epoch"] != epoch:
+                cur = self._cursors[rank] = {"epoch": epoch, "acked": -1,
+                                             "hi": -1}
+            ack = header.get("ack")
+            if ack is not None:
+                cur["acked"] = max(cur["acked"], int(ack))
+            if seq > cur["acked"] + self.max_inflight:
+                self.metrics.inc("throttled", rank)
+                P.send_msg(sock, P.MSG_ERROR, {
+                    "code": "throttle",
+                    "detail": f"seq {seq} is {seq - cur['acked']} past the "
+                              f"acked cursor; max_inflight="
+                              f"{self.max_inflight}",
+                    "retry_ms": 20,
+                })
+                return
+            resend = seq <= cur["hi"]
+        arr = self._rank_array(epoch, rank)
+        lo = seq * batch
+        total = int(arr.shape[0])
+        if lo >= total:
+            P.send_msg(sock, P.MSG_BATCH,
+                       {"seq": seq, "eof": True, "total": total})
+            return
+        fields, payload = P.encode_indices(arr[lo:lo + batch])
+        with self._lock:
+            cur = self._cursors.get(rank)
+            if cur is not None and cur["epoch"] == epoch:
+                cur["hi"] = max(cur["hi"], seq)
+        self.metrics.inc("batches_served", rank)
+        if resend:
+            self.metrics.inc("resends", rank)
+        self._write_snapshot()
+        P.send_msg(sock, P.MSG_BATCH,
+                   {"seq": seq, "eof": False, "total": total, **fields},
+                   payload)

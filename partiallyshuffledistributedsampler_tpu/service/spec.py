@@ -1,0 +1,266 @@
+"""`PartialShuffleSpec`: one serializable description of an index stream.
+
+The server process and every loader client must agree on exactly which
+stream a ``(seed, epoch, rank)`` names — the same dispatch
+``HostDataLoader`` performs locally (plain §3/§4 stream, §8 mixture
+stream, §7 shard-expansion stream, each through the cpu/native/xla
+backends).  This class is that dispatch factored into one value object:
+
+* ``rank_indices(epoch, rank)`` — the rank's full epoch stream as a host
+  array, bit-identical to a local ``HostDataLoader`` of the same config
+  (the loader now *delegates here*, so server and local streams cannot
+  drift);
+* ``to_wire()`` / ``from_wire()`` — a JSON-safe dict that rides in the
+  HELLO handshake and the server snapshot, so a client (or a restarted
+  server) can refuse a config mismatch instead of serving a silently
+  different permutation;
+* ``fingerprint()`` — a stable string of the wire form for cheap
+  equality checks.
+
+The backend field is resolved at construction (``'auto'`` → the shared
+host-side rule) and is deliberately *excluded* from the fingerprint:
+every backend evaluates the same normative stream, so a cpu client may
+talk to a native server.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+import numpy as np
+
+from ..ops import core
+
+_MODES = ("plain", "mixture", "shard")
+
+
+class PartialShuffleSpec:
+    """Immutable-by-convention description of one partial-shuffle stream."""
+
+    def __init__(
+        self,
+        mode: str,
+        *,
+        seed: int = 0,
+        world: int = 1,
+        backend: str = "cpu",
+        n: Optional[int] = None,
+        window: Optional[int] = None,
+        mixture_key=None,
+        epoch_samples: Optional[int] = None,
+        shard_sizes=None,
+        within_shard_shuffle=True,
+        **kwargs,
+    ) -> None:
+        if mode not in _MODES:
+            raise ValueError(f"mode must be one of {_MODES}, got {mode!r}")
+        self.mode = mode
+        self.seed, self.world = int(seed), int(world)
+        if self.world < 1:
+            raise ValueError(f"world must be >= 1, got {world}")
+        if backend == "auto":
+            from ..ops import resolve_host_backend
+
+            backend = resolve_host_backend()
+        from ..ops import ensure_index_backend
+
+        ensure_index_backend(backend)  # fail at construction, not epoch 1
+        self.backend = backend
+        # the sampler kwargs every stream threads through to the core;
+        # use_pallas rides along for the xla backend but is a pure speed
+        # knob (bit-identical output), so it stays out of the wire form
+        self.kwargs = {
+            k: kwargs.pop(k)
+            for k in ("shuffle", "drop_last", "order_windows", "partition",
+                      "rounds", "use_pallas")
+            if k in kwargs
+        }
+        if kwargs:
+            raise TypeError(f"unknown spec kwargs: {sorted(kwargs)}")
+        self.n = None if n is None else int(n)
+        self.window = None if window is None else int(window)
+        self.mixture_key = mixture_key
+        self.epoch_samples = (
+            None if epoch_samples is None else int(epoch_samples)
+        )
+        self.shard_sizes = (
+            None if shard_sizes is None
+            else np.asarray(shard_sizes, dtype=np.int64)
+        )
+        self.within_shard_shuffle = (
+            within_shard_shuffle if isinstance(within_shard_shuffle, bool)
+            else int(within_shard_shuffle)
+        )
+        self._mixture_spec = None
+        if mode == "plain":
+            if self.n is None or self.window is None:
+                raise ValueError("plain mode needs n and window")
+        elif mode == "mixture":
+            if mixture_key is None:
+                raise ValueError("mixture mode needs mixture_key")
+            self._mixture_spec = self._build_mixture()
+        else:  # shard
+            if self.shard_sizes is None:
+                raise ValueError("shard mode needs shard_sizes")
+            if self.window is None:
+                self.window = 64  # the shard sampler's locality default
+
+    # ----------------------------------------------------------- builders
+    @classmethod
+    def plain(cls, n: int, *, window: int, seed: int = 0, world: int = 1,
+              backend: str = "cpu", **kwargs) -> "PartialShuffleSpec":
+        """The single-source §3/§4 stream (what the torch shim serves)."""
+        return cls("plain", n=n, window=window, seed=seed, world=world,
+                   backend=backend, **kwargs)
+
+    @classmethod
+    def mixture(cls, mixture, *, seed: int = 0, world: int = 1,
+                epoch_samples: Optional[int] = None, backend: str = "cpu",
+                **kwargs) -> "PartialShuffleSpec":
+        """The §8 weighted-mixture stream; ``mixture`` is a ``MixtureSpec``
+        or its :meth:`~..ops.mixture.MixtureSpec.key` tuple."""
+        from ..ops.mixture import MixtureSpec
+
+        key = mixture.key() if isinstance(mixture, MixtureSpec) else mixture
+        return cls("mixture", mixture_key=tuple(key), seed=seed, world=world,
+                   epoch_samples=epoch_samples, backend=backend, **kwargs)
+
+    @classmethod
+    def shard(cls, shard_sizes, *, window: int = 64, seed: int = 0,
+              world: int = 1, within_shard_shuffle=True, backend: str = "cpu",
+              **kwargs) -> "PartialShuffleSpec":
+        """The §7 shard-index stream, expanded to global sample indices."""
+        return cls("shard", shard_sizes=shard_sizes, window=window, seed=seed,
+                   world=world, within_shard_shuffle=within_shard_shuffle,
+                   backend=backend, **kwargs)
+
+    def _build_mixture(self):
+        from ..ops.mixture import MixtureSpec
+
+        key = self.mixture_key
+        # wire form arrives as nested lists; from_key wants tuples
+        key = (tuple(key[0]), tuple(key[1]), tuple(key[2]), key[3], key[4])
+        self.mixture_key = key
+        return MixtureSpec.from_key(key)
+
+    @property
+    def mixture_spec(self):
+        return self._mixture_spec
+
+    # -------------------------------------------------------------- sizing
+    def num_samples(self, rank: int = 0) -> Optional[int]:
+        """Per-rank epoch length; ``None`` for shard mode (the expansion
+        length follows the rank's shard draw — serve and count)."""
+        if self.mode == "plain":
+            return core.shard_sizes(
+                self.n, self.world, self.kwargs.get("drop_last", False)
+            )[0]
+        if self.mode == "mixture":
+            from ..ops.mixture import mixture_epoch_sizes
+
+            _, ns, _ = mixture_epoch_sizes(
+                self._mixture_spec, self.epoch_samples, self.world,
+                self.kwargs.get("drop_last", False),
+            )
+            return ns
+        return None
+
+    # ------------------------------------------------------------- streams
+    def rank_indices(self, epoch: int, rank: int) -> np.ndarray:
+        """The rank's full epoch stream as host sample indices — the
+        normative stream every consumer surface of this config serves."""
+        if not 0 <= rank < self.world:
+            raise ValueError(f"rank must be in [0, {self.world}), got {rank}")
+        epoch = int(epoch)
+        if self.mode == "mixture":
+            return self._mixture_indices(epoch, rank)
+        from ..ops import epoch_indices_host
+
+        n = self.n if self.mode == "plain" else len(self.shard_sizes)
+        base = epoch_indices_host(
+            self.backend, n, self.window, self.seed, epoch, rank, self.world,
+            **self.kwargs,
+        )
+        if self.mode == "plain":
+            return base
+        if self.backend == "native":
+            from ..ops.native import expand_shard_indices_native as expand
+        else:
+            from ..sampler.shard_mode import expand_shard_indices_np as expand
+        return expand(
+            base, self.shard_sizes, seed=self.seed, epoch=epoch,
+            within_shard_shuffle=self.within_shard_shuffle,
+            rounds=self.kwargs.get("rounds", core.DEFAULT_ROUNDS),
+        )
+
+    def _mixture_indices(self, epoch: int, rank: int) -> np.ndarray:
+        from ..ops import mixture as M
+
+        kw = dict(
+            epoch_samples=self.epoch_samples,
+            shuffle=self.kwargs.get("shuffle", True),
+            drop_last=self.kwargs.get("drop_last", False),
+            order_windows=self.kwargs.get("order_windows", True),
+            partition=self.kwargs.get("partition", "strided"),
+            rounds=self.kwargs.get("rounds", core.DEFAULT_ROUNDS),
+        )
+        if self.backend == "xla":
+            return np.asarray(M.mixture_epoch_indices_jax(
+                self._mixture_spec, self.seed, epoch, rank, self.world, **kw,
+            ))
+        if self.backend == "native":
+            from ..ops.native import mixture_epoch_indices_native
+
+            return mixture_epoch_indices_native(
+                self._mixture_spec, self.seed, epoch, rank, self.world, **kw,
+            )
+        return M.mixture_epoch_indices_np(
+            self._mixture_spec, self.seed, epoch, rank, self.world, **kw,
+        )
+
+    # ----------------------------------------------------------------- wire
+    def to_wire(self) -> dict:
+        """JSON-safe dict naming the stream (NOT the backend — every
+        backend serves the same normative stream)."""
+        d = {
+            "mode": self.mode,
+            "seed": self.seed,
+            "world": self.world,
+            "kwargs": {k: self.kwargs[k] for k in sorted(self.kwargs)
+                       if k != "use_pallas"},
+        }
+        if self.mode == "plain":
+            d["n"] = self.n
+            d["window"] = self.window
+        elif self.mode == "mixture":
+            k = self.mixture_key
+            d["mixture_key"] = [list(k[0]), list(k[1]), list(k[2]),
+                                k[3], k[4]]
+            d["epoch_samples"] = self.epoch_samples
+        else:
+            d["shard_sizes"] = [int(s) for s in self.shard_sizes]
+            d["window"] = self.window
+            d["within_shard_shuffle"] = self.within_shard_shuffle
+        return d
+
+    @classmethod
+    def from_wire(cls, d: dict, *, backend: str = "cpu") -> "PartialShuffleSpec":
+        d = dict(d)
+        kwargs = d.pop("kwargs", {})
+        mk = d.pop("mixture_key", None)
+        if mk is not None:
+            d["mixture_key"] = (tuple(mk[0]), tuple(mk[1]), tuple(mk[2]),
+                                mk[3], mk[4])
+        return cls(d.pop("mode"), backend=backend, **d, **kwargs)
+
+    def fingerprint(self) -> str:
+        return json.dumps(self.to_wire(), sort_keys=True,
+                          separators=(",", ":"))
+
+    def __eq__(self, other) -> bool:
+        return (isinstance(other, PartialShuffleSpec)
+                and self.fingerprint() == other.fingerprint())
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"PartialShuffleSpec({self.fingerprint()})"

@@ -1,5 +1,5 @@
 """Observability utilities: stall probe and regen-latency metrics."""
 
 from .checkpoint import load_sampler_state, save_sampler_state  # noqa: F401
-from .metrics import RegenTimer  # noqa: F401
+from .metrics import MetricsRegistry, RegenTimer  # noqa: F401
 from .stall_probe import StallProbe  # noqa: F401

@@ -2,11 +2,14 @@
 
 Lightweight, dependency-free; samplers and the bench harness share it so the
 number reported by ``bench.py`` and the number a training loop observes are
-produced the same way.
+produced the same way.  :class:`MetricsRegistry` is the shared named-metric
+surface subsystems export through (the index service daemon's counters ride
+here, so its smoke gate, ``bench.py`` and an operator poll read one report).
 """
 
 from __future__ import annotations
 
+import threading
 import time
 from contextlib import contextmanager
 
@@ -49,3 +52,52 @@ class RegenTimer:
             "last_ms": round(self.last_ms, 3),
             "mean_ms": round(self.mean_ms, 3),
         }
+
+
+class MetricsRegistry:
+    """Thread-safe named counters + latency timers under one report.
+
+        reg = MetricsRegistry()
+        reg.inc("batches_served")
+        with reg.timer("epoch_regen_ms").measure():
+            regenerate()
+        reg.report()  # {"counters": {...}, "timers": {name: {...}}}
+
+    Counters are plain monotonically-increasing ints; timers are
+    :class:`RegenTimer` instances created on first use.  Every method is
+    safe from concurrent threads (the service daemon increments from one
+    thread per connection)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: dict[str, int] = {}
+        self._timers: dict[str, RegenTimer] = {}
+
+    def inc(self, name: str, value: int = 1) -> int:
+        with self._lock:
+            new = self._counters.get(name, 0) + int(value)
+            self._counters[name] = new
+            return new
+
+    def get(self, name: str) -> int:
+        with self._lock:
+            return self._counters.get(name, 0)
+
+    def timer(self, name: str) -> RegenTimer:
+        with self._lock:
+            t = self._timers.get(name)
+            if t is None:
+                t = self._timers[name] = RegenTimer()
+            return t
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._timers.clear()
+
+    def report(self) -> dict:
+        with self._lock:
+            return {
+                "counters": dict(self._counters),
+                "timers": {k: t.report() for k, t in self._timers.items()},
+            }

@@ -67,3 +67,50 @@ def test_concurrent_epoch_generation_threads():
     for t in threads:
         t.join()
     assert not errors, errors[:3]
+
+
+def _regen_threads():
+    return [t for t in threading.enumerate()
+            if t.name == "psds-regen-prefetch" and t.is_alive()]
+
+
+def test_set_epoch_hammer_does_not_accumulate_threads():
+    """Hammering set_epoch (schedulers re-announce the epoch; elastic
+    controllers jump around) must keep at most ONE live regen thread —
+    each respawn now retires the stale prefetch first, and a same-epoch
+    call skips the respawn entirely."""
+    s = PartiallyShuffleDistributedSampler(
+        200_000, num_replicas=2, rank=0, window=512, seed=3, backend="cpu"
+    )
+    for i in range(50):
+        s.set_epoch(i % 7)
+    assert len(_regen_threads()) <= 1
+    # same-epoch repeat keeps the in-flight prefetch (no respawn)
+    s.set_epoch(99)
+    pending = s._pending
+    s.set_epoch(99)
+    assert s._pending is pending
+    # and the stream is still the hammered-to epoch's, bit-correct
+    assert list(s) == cpu.epoch_indices_np(200_000, 512, 3, 99, 0, 2)[:len(s)].tolist()
+
+
+def test_mixture_set_epoch_hammer_does_not_accumulate_threads():
+    from partiallyshuffledistributedsampler_tpu.ops import mixture as M
+    from partiallyshuffledistributedsampler_tpu.sampler import (
+        PartialShuffleMixtureSampler,
+    )
+
+    s = PartialShuffleMixtureSampler(
+        [40_000, 20_000], [2, 1], num_replicas=2, rank=0, seed=5,
+        windows=16, block=100, backend="cpu"
+    )
+    for i in range(50):
+        s.set_epoch(i % 7)
+    assert len(_regen_threads()) <= 1
+    s.set_epoch(42)
+    pending = s._pending
+    s.set_epoch(42)
+    assert s._pending is pending
+    spec = M.MixtureSpec([40_000, 20_000], [2, 1], windows=16, block=100)
+    ref = M.mixture_epoch_indices_np(spec, 5, 42, 0, 2)
+    assert np.array_equal(np.fromiter(iter(s), dtype=np.int64), ref)

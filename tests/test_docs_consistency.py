@@ -23,6 +23,7 @@ _NAMESPACES = (
     "partiallyshuffledistributedsampler_tpu.sampler",
     "partiallyshuffledistributedsampler_tpu.ops",
     "partiallyshuffledistributedsampler_tpu.ops.cpu",
+    "partiallyshuffledistributedsampler_tpu.service",
 )
 
 

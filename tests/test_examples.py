@@ -48,3 +48,13 @@ def test_llama3_10b_index_example():
     assert "bit-identical to numpy" in out
     assert "rank 0 won" in out
     assert "ok: config-5 shape end to end" in out
+
+
+def test_index_service_example():
+    # pin the CPU platform: the service/loader parity is platform-free and
+    # the emulated-TPU tunnel makes the per-batch device_puts crawl
+    out = run_example("index_service_example.py", {"JAX_PLATFORMS": "cpu"},
+                      timeout=180)
+    assert "bit-identical to the local sampler" in out
+    assert "exactly-once, bit-identical" in out
+    assert "ok: index service end to end" in out

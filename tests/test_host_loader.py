@@ -293,3 +293,35 @@ def test_mixture_loader_xla_backend_matches_cpu():
                        index_backend="xla")
     for ba, bb in zip(a.epoch(3), b.epoch(3)):
         assert np.array_equal(np.asarray(ba), np.asarray(bb))
+
+
+def test_epoch_index_cache_dropped_on_exhaustion():
+    """The one-entry index cache exists so epoch_steps + epoch share one
+    regen; it must NOT pin a (potentially huge) epoch array after the
+    epoch is fully consumed, and clear_cache() must drop it on demand."""
+    loader = make(data=np.arange(N))
+    for _ in loader.epoch(1):
+        pass
+    assert loader._idx_cache is None  # exhaustion reclaimed the array
+
+    idx = loader.epoch_indices(2)
+    assert loader._idx_cache is not None
+    assert loader.epoch_indices(2) is idx  # cache hit while live
+    loader.clear_cache()
+    assert loader._idx_cache is None
+    assert np.array_equal(loader.epoch_indices(2), idx)  # recompute matches
+
+
+def test_early_exit_also_reclaims_cache():
+    """Abandoning an epoch mid-way closes the prefetch generator, and the
+    close path reclaims the cached index array just like exhaustion — a
+    resume recomputes the same stream deterministically."""
+    loader = make()
+    it = iter(loader.epoch(3))
+    next(it)
+    it.close()
+    assert loader._idx_cache is None
+    ref = ref_batches(3)[0]
+    resumed = next(iter(loader.epoch(3)))
+    assert np.array_equal(np.asarray(resumed["x"]),
+                          np.arange(N * 3).reshape(N, 3)[ref])

@@ -914,3 +914,35 @@ def test_fused_gather_strategies_bit_identical(monkeypatch):
     ref2 = M.mixture_stream_at_np(pos, spec2, 9, 4, fused=False)
     assert np.array_equal(ref2, chained)
     assert np.array_equal(ref, chained)  # same spec params, same stream
+
+
+def test_packed_slot_table_block_cap(monkeypatch):
+    """The slot pack stores the prefix count in bits 8..31, so a block at
+    or past 2^24 would wrap the count and serve a silently wrong stream —
+    the guard must return None there (regression: the guard used to check
+    only S >= 256).  The cap is forced down to this spec's block so the
+    boundary executes without allocating a 2^24 pattern."""
+    spec = M.MixtureSpec([40, 30], [1, 1], windows=2, block=16)
+    assert spec.packed_slot_table() is not None  # below the cap: packs
+
+    at_cap = M.MixtureSpec([40, 30], [1, 1], windows=2, block=16)
+    monkeypatch.setattr(M.MixtureSpec, "_PACK_SLOT_B_CAP", 16)
+    assert at_cap.packed_slot_table() is None    # block == cap: refused
+
+    just_under = M.MixtureSpec([40, 30], [1, 1], windows=2, block=16)
+    monkeypatch.setattr(M.MixtureSpec, "_PACK_SLOT_B_CAP", 17)
+    t = just_under.packed_slot_table()
+    assert t is not None and t.dtype == np.uint32
+    # the packed lanes decode back to the spec's pattern + prefix counts
+    assert np.array_equal(t & 0xFF, just_under.pattern)
+    own = just_under.prefix[np.arange(16), just_under.pattern]
+    assert np.array_equal(t >> 8, own)
+
+    # and the fused evaluator falls back bit-identically when refused
+    monkeypatch.setattr(M.MixtureSpec, "_PACK_SLOT_B_CAP", 1)
+    a = M.mixture_epoch_indices_np(at_cap, 5, 2, 0, 1)
+    monkeypatch.undo()
+    b = M.mixture_epoch_indices_np(M.MixtureSpec([40, 30], [1, 1],
+                                                 windows=2, block=16),
+                                   5, 2, 0, 1)
+    assert np.array_equal(a, b)
