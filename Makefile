@@ -20,10 +20,14 @@
 #                          pipeline end to end, reduced reps
 #   * service smoke        benchmarks/service_smoke.py — index daemon +
 #                          4 clients, streams == local sampler, metrics
+#   * chaos smoke          tests/test_chaos.py fault matrix (`-m chaos`)
+#                          + benchmarks/chaos_smoke.py — server kill/
+#                          restart recovery and degraded-mode fallback,
+#                          streams asserted bit-identical throughout
 
 PY ?= python
 
-.PHONY: check test bench native dryrun service-smoke
+.PHONY: check test bench native dryrun service-smoke chaos-smoke
 
 # the driver parses the LAST line of bench.py's combined output (round 3
 # lost its headline to the details line — BENCH_r03.json "parsed": null),
@@ -57,6 +61,13 @@ bench:
 # local sampler, metrics endpoint asserted to account for the traffic
 service-smoke:
 	$(PY) benchmarks/service_smoke.py
+
+# resilience gate (docs/RESILIENCE.md): the deterministic fault matrix
+# (every fault site x stream mode -> bit-identical stream or typed error,
+# never a hang), then the kill/restart + degraded-fallback latency smoke
+chaos-smoke:
+	$(PY) -m pytest tests/test_chaos.py -q -m chaos -ra
+	$(PY) benchmarks/chaos_smoke.py
 
 native:
 	$(MAKE) -C csrc

@@ -268,6 +268,17 @@ def main() -> None:
         except Exception as exc:
             details["service_error"] = repr(exc)[:200]
 
+    # detail tier: resilience latencies — server-kill recovery and the
+    # loader's degraded-mode switch (methodology in benchmarks/chaos_smoke.py)
+    if not smoke:
+        try:
+            sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+            from benchmarks.chaos_smoke import summarize as chaos_summarize
+
+            details["chaos"] = chaos_summarize()
+        except Exception as exc:
+            details["chaos_error"] = repr(exc)[:200]
+
     print(json.dumps(details), file=sys.stderr, flush=True)
     if not metric_printed:
         raise SystemExit("no backend produced a timing")

@@ -1,0 +1,159 @@
+"""Chaos smoke + recovery-latency harness for the served-index stack.
+
+Two consumers:
+
+* ``make chaos-smoke`` / ``python benchmarks/chaos_smoke.py`` — the CI
+  gate: kill an :class:`IndexServer` mid-epoch and assert (a) a client
+  that keeps retrying resumes bit-identically once the server is back,
+  and (b) a :class:`HostDataLoader` whose daemon stays down degrades to
+  local regen with a bit-identical stream, then re-attaches.  Exit 0 and
+  one JSON line on success; raises loudly on any mismatch.
+
+* ``bench.py`` imports :func:`summarize` — the ``details["chaos"]``
+  tier: *recovery latency* (server kill → restart → first post-recovery
+  batch, ms; dominated by the client's jittered backoff schedule) and
+  *degraded-switch latency* (server kill → loader falls back to local
+  regen, ms; dominated by the client's ``reconnect_timeout`` deadline).
+
+Both figures describe the resilience layer (docs/RESILIENCE.md), not the
+network: everything runs on loopback with deliberately short deadlines.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+import warnings
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _recovery_latency_ms(*, n: int = 20_000, window: int = 128,
+                         batch: int = 512, epoch: int = 1) -> dict:
+    """Kill the server mid-epoch, restart it on the same port, and time
+    kill → first post-recovery batch.  The resumed stream must be
+    bit-identical to the uninterrupted local stream (the server's reply
+    is a pure function of ``(epoch, seq)``, so the kill can tear state
+    without corrupting the sequence)."""
+    from partiallyshuffledistributedsampler_tpu.service import (
+        IndexServer,
+        PartialShuffleSpec,
+        ServiceIndexClient,
+    )
+
+    spec = PartialShuffleSpec.plain(n, window=window, seed=0, world=1)
+    ref = np.asarray(spec.rank_indices(epoch, 0))
+    srv = IndexServer(spec)
+    host, port = srv.start()
+    got = []
+    with ServiceIndexClient((host, port), rank=0, batch=batch,
+                            reconnect_timeout=20.0,
+                            backoff_base=0.02) as client:
+        it = client.epoch_batches(epoch)
+        total = -(-len(ref) // batch)
+        half = max(1, total // 2)
+        for _ in range(half):
+            got.append(next(it))
+        srv.stop()
+        t_kill = time.perf_counter()
+        srv.start()  # same instance re-binds the same (host, port)
+        try:
+            got.append(next(it))  # blocks in the retry layer until back
+            recovery_ms = (time.perf_counter() - t_kill) * 1e3
+            for b in it:
+                got.append(b)
+        finally:
+            srv.stop()
+    stream = np.concatenate(got)
+    if not np.array_equal(stream, ref):
+        raise AssertionError(
+            "post-recovery stream != uninterrupted local stream"
+        )
+    return {"recovery_ms": round(recovery_ms, 3),
+            "batches": len(got), "killed_after": half}
+
+
+def _degraded_switch_ms(*, n: int = 20_000, window: int = 128,
+                        batch: int = 512, epoch: int = 1) -> dict:
+    """Kill the server for good, and time how long the loader takes to
+    give up on it and serve the epoch from the local spec — which must
+    be bit-identical to what a pure-local loader produces."""
+    from partiallyshuffledistributedsampler_tpu.sampler.host_loader import (
+        HostDataLoader,
+    )
+    from partiallyshuffledistributedsampler_tpu.service import (
+        IndexServer,
+        PartialShuffleSpec,
+        ServiceIndexClient,
+    )
+
+    X = np.arange(n, dtype=np.int64)
+    local = HostDataLoader(X, window=window, batch=batch, seed=0,
+                           rank=0, world=1)
+    ref = local.epoch_indices(epoch)
+
+    spec = PartialShuffleSpec.plain(n, window=window, seed=0, world=1)
+    srv = IndexServer(spec)
+    addr = srv.start()
+    client = ServiceIndexClient(addr, rank=0, batch=batch,
+                                reconnect_timeout=0.4, backoff_base=0.02)
+    loader = HostDataLoader(X, window=window, batch=batch, seed=0,
+                            rank=0, world=1, index_client=client,
+                            reattach_interval=0.05)
+    # epoch 0 over the live service proves the healthy path first
+    warm = loader.epoch_indices(0)
+    assert np.array_equal(warm, local.epoch_indices(0)), \
+        "healthy served stream != local stream"
+    srv.stop()
+    t_kill = time.perf_counter()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        got = loader.epoch_indices(epoch)
+    switch_ms = (time.perf_counter() - t_kill) * 1e3
+    if not loader.degraded:
+        raise AssertionError("loader did not enter degraded mode")
+    if not np.array_equal(got, ref):
+        raise AssertionError("degraded-mode stream != local stream")
+    # the daemon returns; the next epoch must probe and re-attach
+    srv.start()
+    time.sleep(0.06)  # past reattach_interval
+    back = loader.epoch_indices(epoch + 1)
+    reattached = not loader.degraded
+    srv.stop()
+    client.close()
+    if not reattached:
+        raise AssertionError("loader did not re-attach after restart")
+    if not np.array_equal(back, local.epoch_indices(epoch + 1)):
+        raise AssertionError("post-re-attach stream != local stream")
+    return {
+        "degraded_switch_ms": round(switch_ms, 3),
+        "reconnect_timeout_s": client.reconnect_timeout,
+        "degraded_entries": int(
+            client.metrics.report()["counters"].get("degraded_mode", 0)),
+        "reattached": reattached,
+    }
+
+
+def summarize(**kw) -> dict:
+    """The bench.py ``details["chaos"]`` tier."""
+    return {
+        "recovery": _recovery_latency_ms(**kw),
+        "degraded": _degraded_switch_ms(**kw),
+    }
+
+
+def main() -> None:
+    """The `make chaos-smoke` gate: both scenarios, hard assertions."""
+    out = summarize()
+    assert out["recovery"]["recovery_ms"] > 0
+    assert out["degraded"]["reattached"] is True
+    assert out["degraded"]["degraded_entries"] >= 1
+    print(json.dumps({"chaos_smoke": "ok", **out}))
+
+
+if __name__ == "__main__":
+    main()

@@ -31,13 +31,18 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
+import warnings
 from typing import Iterator, Optional
 
 import numpy as np
 
+from .. import faults as F
 from ..ops import core, ensure_index_backend
+from ..utils.watchdog import StallError
 
 _SENTINEL = object()
+_ERROR = object()
 
 
 class HostDataLoader:
@@ -85,6 +90,24 @@ class HostDataLoader:
         ``PartialShuffleSpec`` this loader builds), so checkpoints
         interoperate; elastic ``layers`` are a local-sampler feature and
         raise on the service path.
+    degraded_fallback: served-stream resilience (docs/RESILIENCE.md).
+        When the daemon stays unreachable past the client's
+        ``reconnect_timeout``, compute the epoch locally from the same
+        spec instead of failing the epoch — the fingerprint handshake
+        guarantees the fallback stream is bit-identical to what the
+        daemon would have served.  Entering degraded mode warns once and
+        counts ``degraded_mode`` on the client's metrics; every
+        ``reattach_interval`` seconds a later epoch probes the daemon
+        and re-attaches when it returns.  False restores strict
+        fail-on-unavailable behavior.
+    reattach_interval: minimum seconds between re-attach probes while
+        degraded (each probe costs one TCP dial).
+    stall_timeout: prefetch watchdog deadline (seconds).  If the gather
+        thread makes no progress for this long — wedged in a gather, or
+        dead without delivering a batch or an error — the consumer gets
+        a typed :class:`~..utils.watchdog.StallError` carrying the stuck
+        thread's stack instead of blocking forever.  ``None`` disables
+        the watchdog.
 
     The sampler kwargs (shuffle/drop_last/order_windows/partition/rounds)
     pass through to the index core unchanged.
@@ -108,6 +131,9 @@ class HostDataLoader:
         shard_sizes=None,
         within_shard_shuffle=True,
         index_client=None,
+        degraded_fallback=True,
+        reattach_interval: float = 5.0,
+        stall_timeout: Optional[float] = 30.0,
         **kwargs,
     ) -> None:
         if mixture is not None and shard_sizes is not None:
@@ -224,6 +250,14 @@ class HostDataLoader:
         self.kwargs = kwargs
         self.num_samples = num_samples
         self.index_client = index_client
+        self.degraded_fallback = bool(degraded_fallback)
+        self.reattach_interval = float(reattach_interval)
+        self.stall_timeout = (
+            None if stall_timeout is None else float(stall_timeout)
+        )
+        #: True while serving locally because the index daemon is down
+        self.degraded = False
+        self._last_probe = float("-inf")
         # ONE description of this loader's stream, shared verbatim with the
         # index service (service/spec.py) — local regen and a daemon serving
         # the same config cannot drift because both evaluate this object
@@ -351,7 +385,8 @@ class HostDataLoader:
                     "elastic layers are a local-sampler feature; the index "
                     "service path does not serve remainder epochs"
                 )
-            return np.asarray(self.index_client.epoch_indices(epoch))
+            return self._served_indices(epoch)
+        F.fire("loader.regen")
         if layers is None:
             # the shared stream description (service/spec.py) — the same
             # object an IndexServer of this config evaluates
@@ -371,6 +406,63 @@ class HostDataLoader:
             within_shard_shuffle=self.within_shard_shuffle,
             rounds=self.kwargs.get("rounds", core.DEFAULT_ROUNDS),
         )
+
+    def _served_indices(self, epoch: int) -> np.ndarray:
+        """The service path with graceful degradation (docs/RESILIENCE.md).
+
+        Healthy: fetch the epoch stream from the daemon.  If the daemon
+        stays down past the client's ``reconnect_timeout`` and
+        ``degraded_fallback`` is on, compute the stream locally from the
+        same :class:`~..service.spec.PartialShuffleSpec` — bit-identical
+        by the fingerprint handshake — and keep training; while degraded,
+        probe the daemon at most every ``reattach_interval`` seconds and
+        re-attach when it answers."""
+        from ..service.client import ServiceUnavailable
+
+        client = self.index_client
+        if self.degraded:
+            now = time.monotonic()
+            if now - self._last_probe < self.reattach_interval:
+                return self._local_indices(epoch)
+            self._last_probe = now
+            if not client.probe():
+                return self._local_indices(epoch)
+            self.degraded = False
+            client.metrics.inc("reattached", self.rank)
+        try:
+            return np.asarray(client.epoch_indices(epoch))
+        except ServiceUnavailable as exc:
+            if not self.degraded_fallback:
+                raise
+            warnings.warn(
+                f"index service unavailable ({exc}); serving epoch "
+                f"{epoch} from the local spec (bit-identical stream) and "
+                "probing for re-attach",
+                RuntimeWarning,
+                stacklevel=3,
+            )
+            client.metrics.inc("degraded_mode", self.rank)
+            self.degraded = True
+            self._last_probe = time.monotonic()
+            return self._local_indices(epoch)
+
+    def _local_indices(self, epoch: int) -> np.ndarray:
+        """Degraded-mode regen: evaluate the loader's own spec.  Safe to
+        substitute for the served stream because the WELCOME handshake
+        already proved the daemon serves a spec with this fingerprint."""
+        wire = getattr(self.index_client, "spec_wire", None)
+        if wire is not None:
+            from ..service.spec import PartialShuffleSpec
+
+            served = PartialShuffleSpec.from_wire(wire).fingerprint()
+            ours = self.stream_spec.fingerprint()
+            if served != ours:
+                raise RuntimeError(
+                    f"cannot degrade to local regen: daemon spec "
+                    f"fingerprint {served} != local {ours}"
+                )
+        F.fire("loader.regen")
+        return np.asarray(self.stream_spec.rank_indices(epoch, self.rank))
 
     def _base_indices(self, epoch: int, layers) -> np.ndarray:
         from ..ops.cpu import elastic_indices_np
@@ -458,6 +550,28 @@ class HostDataLoader:
         follows the rank's shard draw."""
         return self._steps_for(len(self.epoch_indices(epoch, layers)))
 
+    def _check_stall(self, thread: threading.Thread, progress: dict) -> None:
+        """Raise :class:`StallError` when the gather thread is dead
+        without having delivered a result, or has made no progress for
+        ``stall_timeout`` seconds.  Called from the consumer's timed
+        poll, so the error surfaces at the training loop — with the
+        stuck thread's stack attached — instead of hanging it."""
+        if not thread.is_alive():
+            raise StallError(
+                "prefetch thread died without delivering a batch, an "
+                "error, or the end-of-epoch sentinel",
+                thread=thread,
+            )
+        if self.stall_timeout is None:
+            return
+        stalled = time.monotonic() - progress["ts"]
+        if stalled > self.stall_timeout:
+            raise StallError(
+                f"prefetch thread made no progress for {stalled:.1f}s "
+                f"(stall_timeout={self.stall_timeout:.1f}s)",
+                thread=thread,
+            )
+
     # -------------------------------------------------------------- epochs
     def epoch(self, epoch: int, *, start_step: int = 0,
               layers=None) -> Iterator:
@@ -489,12 +603,28 @@ class HostDataLoader:
 
         q: queue.Queue = queue.Queue(maxsize=self.depth)
         stop = threading.Event()
+        # watchdog state: the producer stamps progress; the consumer's
+        # timed poll compares against it so a wedged or silently-dead
+        # gather thread becomes a typed StallError, never an infinite wait
+        progress = {"ts": time.monotonic()}
+        errbox: list = []
+
+        def _put(item) -> bool:
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    progress["ts"] = time.monotonic()
+                    continue
+            return False
 
         def produce() -> None:
             try:
                 for s in range(start_step, steps):
                     if stop.is_set():
                         return
+                    F.fire("loader.prefetch")
                     lo = s * self.batch
                     sl = idx[lo:lo + self.batch]
                     # host gather then ASYNC device transfer: device_put
@@ -506,38 +636,38 @@ class HostDataLoader:
                     }
                     if self._single:
                         out = out["data"]
-                    while not stop.is_set():
-                        try:
-                            q.put(out, timeout=0.1)
-                            break
-                        except queue.Full:
-                            continue
-            except Exception as exc:  # surface gather errors to the consumer
-                while not stop.is_set():
-                    try:
-                        q.put(("__error__", exc), timeout=0.1)
+                    progress["ts"] = time.monotonic()
+                    if not _put(out):
                         return
-                    except queue.Full:
-                        continue
-            else:
-                while not stop.is_set():
-                    try:
-                        q.put(_SENTINEL, timeout=0.1)
-                        return
-                    except queue.Full:
-                        continue
+            except F.InjectedThreadDeath:
+                return  # simulated silent death: no error, no sentinel
+            except Exception as exc:
+                # deliver the ORIGINAL exception object (its traceback
+                # intact) — the consumer re-raises it, so the user's
+                # stack shows the real gather failure, not loader goo
+                errbox.append(exc)
+                _put(_ERROR)
+                return
+            _put(_SENTINEL)
 
         t = threading.Thread(target=produce, daemon=True,
                              name="psds-host-prefetch")
         t.start()
+        poll = (
+            min(0.25, self.stall_timeout / 4)
+            if self.stall_timeout else 0.25
+        )
         try:
             while True:
-                item = q.get()
+                try:
+                    item = q.get(timeout=poll)
+                except queue.Empty:
+                    self._check_stall(t, progress)
+                    continue
                 if item is _SENTINEL:
                     break
-                if isinstance(item, tuple) and len(item) == 2 \
-                        and item[0] == "__error__":
-                    raise item[1]
+                if item is _ERROR:
+                    raise errbox[0]
                 yield item
         finally:
             # consumer broke out (or errored): unblock and retire the thread

@@ -21,13 +21,13 @@ Drop-in surfaces:
 
 from __future__ import annotations
 
-import random
 import socket
 import time
 from typing import Iterator, Optional
 
 import numpy as np
 
+from ..utils.retry import RetryPolicy
 from . import protocol as P
 from .metrics import ServiceMetrics
 
@@ -37,6 +37,10 @@ _FATAL_CODES = frozenset(
     {"proto", "world", "spec", "batch", "bad_request", "unknown_type",
      "protocol", "no_rank"}
 )
+
+#: consecutive checksum rejects on one seq before the client gives up on
+#: re-requesting (a link that corrupts every replay is not transient)
+_MAX_CHECKSUM_REJECTS = 4
 
 
 class ServiceError(RuntimeError):
@@ -77,10 +81,15 @@ class ServiceIndexClient:
     timeout:     per-request socket timeout (seconds).
     reconnect_timeout: total time the retry layer keeps trying to reach a
                  server before raising :class:`ServiceUnavailable`.
-    backoff_base/backoff_max: exponential-backoff bounds; each sleep is
-                 jittered to ``[0.5, 1.5)`` of the nominal value so N
-                 clients dropped by one restart don't reconnect in
-                 lockstep.
+    backoff_base/backoff_max: exponential-backoff bounds, consumed by the
+                 default :class:`~..utils.retry.RetryPolicy` (full
+                 jitter, so N clients dropped by one restart don't
+                 reconnect in lockstep).
+    retry_policy: a :class:`~..utils.retry.RetryPolicy` overriding the
+                 one built from the three knobs above; carries the
+                 circuit breaker that makes a dead daemon fail fast
+                 between operations instead of paying the full deadline
+                 on every call.
     """
 
     def __init__(
@@ -95,6 +104,7 @@ class ServiceIndexClient:
         backoff_base: float = 0.05,
         backoff_max: float = 2.0,
         metrics: Optional[ServiceMetrics] = None,
+        retry_policy: Optional[RetryPolicy] = None,
     ) -> None:
         self.address = _parse_address(address)
         self.rank = None if rank is None else int(rank)
@@ -106,6 +116,16 @@ class ServiceIndexClient:
         self.reconnect_timeout = float(reconnect_timeout)
         self.backoff_base = float(backoff_base)
         self.backoff_max = float(backoff_max)
+        self.retry_policy = retry_policy if retry_policy is not None \
+            else RetryPolicy(
+                base=self.backoff_base, max_delay=self.backoff_max,
+                deadline=self.reconnect_timeout,
+                # open only after enough consecutive failures to have
+                # exhausted a typical _rpc deadline, and re-probe quickly:
+                # the breaker exists to fail FAST between operations, not
+                # to delay recovery
+                breaker_threshold=12, breaker_reset=1.0,
+            )
         self.metrics = metrics if metrics is not None else ServiceMetrics()
         self.spec_wire: Optional[dict] = None
         self.server_epoch: Optional[int] = None
@@ -149,6 +169,24 @@ class ServiceIndexClient:
         if self._sock is None:
             self._connect()
 
+    def probe(self) -> bool:
+        """One connection attempt, no retries: is a daemon serving right
+        now?  The degraded-mode loader polls this to decide when to
+        re-attach; a False answer leaves the client closed and costs one
+        refused TCP dial."""
+        if self._sock is not None:
+            return True
+        try:
+            self._connect()
+            self.retry_policy.record_success()
+            return True
+        except (OSError, ServiceError, P.ProtocolError):
+            # includes ConnectionError/timeout; fatal config mismatches
+            # also read as "not attachable" here — the next real stream
+            # attempt surfaces them loudly
+            self.close()
+            return False
+
     def close(self) -> None:
         sock, self._sock = self._sock, None
         if sock is not None:
@@ -169,10 +207,22 @@ class ServiceIndexClient:
         """One request → reply, retrying across connection loss.
 
         Every message this client sends is idempotent, so a reconnect +
-        replay can never double-deliver; ``throttle`` errors sleep the
-        server-suggested interval and retry on the live connection."""
-        deadline = time.monotonic() + self.reconnect_timeout
-        attempt = 0
+        replay can never double-deliver.  All waiting rides the unified
+        :class:`RetryPolicy` (full-jittered exponential backoff under one
+        per-operation deadline) — reconnects and lease races alike, so N
+        ranks dropped by one restart never retry in lockstep.  A server
+        ``throttle``/``draining`` reply sleeps at least the
+        server-suggested interval.  The policy's circuit breaker makes a
+        freshly-exhausted dependency fail fast at the *next* operation's
+        entry instead of burning its full deadline again."""
+        pol = self.retry_policy
+        if not pol.allow():
+            raise ServiceUnavailable(
+                f"circuit open toward {self.address} (recent operations "
+                "exhausted their retry deadlines); next probe after "
+                f"{pol.breaker_reset}s"
+            )
+        op = pol.begin()
         while True:
             try:
                 try:
@@ -183,46 +233,55 @@ class ServiceIndexClient:
                     # our own just-dropped lease may not have been released
                     # yet (the server notices the dead conn asynchronously);
                     # back off and re-HELLO like any other lease race
-                    if time.monotonic() > deadline:
+                    if not op.pause():
                         raise
-                    time.sleep(self.backoff_base)
                     continue
                 if "rank" in header:
                     # the lazy connect (or a re-HELLO after lease loss) is
                     # what assigns auto-claimed ranks — stamp the current
                     # one on every attempt
                     header["rank"] = self.rank
-                P.send_msg(self._sock, msg_type, header)
-                reply, rheader, payload = P.recv_msg(self._sock)
+                P.send_msg(self._sock, msg_type, header,
+                           site="service.send")
+                reply, rheader, payload = P.recv_msg(self._sock,
+                                                     site="service.recv")
             except (ConnectionError, socket.timeout, OSError,
                     P.ProtocolError) as exc:
                 self.close()
-                attempt += 1
                 self.metrics.inc("reconnects", self.rank)
-                delay = min(self.backoff_max,
-                            self.backoff_base * (2 ** (attempt - 1)))
-                delay *= 0.5 + random.random()  # jitter: desynchronize herds
-                if time.monotonic() + delay > deadline:
+                pol.record_failure()
+                if not op.pause():
                     raise ServiceUnavailable(
-                        f"no server at {self.address} after {attempt} "
+                        f"no server at {self.address} after {op.attempts} "
                         f"attempts ({exc!r})"
                     ) from None
-                time.sleep(delay)
                 continue
+            pol.record_success()
             if reply == P.MSG_ERROR:
                 code = rheader.get("code", "error")
                 if code == "throttle":
                     self.metrics.inc("throttled", self.rank)
                     time.sleep(float(rheader.get("retry_ms", 20)) / 1e3)
                     continue
+                if code == "draining":
+                    # graceful shutdown in progress: drop the conn and come
+                    # back after (at least) the server-suggested interval
+                    self.close()
+                    self.metrics.inc("drain_redirects", self.rank)
+                    retry_s = float(rheader.get("retry_ms", 100)) / 1e3
+                    if not op.pause(min_delay=retry_s):
+                        raise ServiceUnavailable(
+                            f"server at {self.address} is draining and did "
+                            "not return within the retry deadline"
+                        )
+                    continue
                 if code == "not_owner" or code == "rank_taken":
                     # lease lost (eviction or a racing claimant): re-HELLO
                     # once the stale claimant's lease clears; fatal only if
                     # it never does within the deadline
                     self.close()
-                    if time.monotonic() > deadline:
+                    if not op.pause():
                         raise ServiceError(code, rheader.get("detail", ""))
-                    time.sleep(self.backoff_base)
                     continue
                 raise ServiceError(code, rheader.get("detail", ""))
             return reply, rheader, payload
@@ -237,6 +296,7 @@ class ServiceIndexClient:
         comfortably inside any server's ``max_inflight``."""
         epoch, seq = int(epoch), int(start_seq)
         self._cursor = {"epoch": epoch, "seq": seq}
+        rejects = 0
         while True:
             reply, header, payload = self._rpc(P.MSG_GET_BATCH, {
                 "rank": self.rank, "epoch": epoch, "seq": seq,
@@ -248,7 +308,19 @@ class ServiceIndexClient:
                 )
             if header.get("eof"):
                 return
-            arr = P.decode_indices(header, payload)
+            try:
+                arr = P.decode_indices(header, payload)
+            except P.ChecksumError:
+                # the payload arrived corrupted; the reply is idempotent,
+                # so reject it and re-request the SAME seq — the delivered
+                # stream stays exact.  Persistent corruption is a broken
+                # link, not a transient: give up after a few replays.
+                rejects += 1
+                self.metrics.inc("checksum_rejects", self.rank)
+                if rejects > _MAX_CHECKSUM_REJECTS:
+                    raise
+                continue
+            rejects = 0
             self.metrics.inc("batches_served", self.rank)
             # advance BEFORE yielding: once the consumer holds the batch it
             # counts as delivered, so a state_dict() taken between batches

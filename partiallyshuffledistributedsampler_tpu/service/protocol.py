@@ -12,6 +12,9 @@ One frame on the wire is
 The header carries the small structured fields (rank, epoch, seq, error
 codes); the payload is reserved for bulk index data so a batch costs one
 JSON parse of a ~100-byte header, never a JSON encode of the indices.
+BATCH headers additionally carry ``crc32`` over the payload; a receiver
+that sees a mismatch raises :class:`ChecksumError` and (being idempotent)
+simply re-requests the same seq.
 
 Versioning: ``HELLO`` carries ``proto=PROTOCOL_VERSION`` and the server
 refuses mismatches up front, so a framing change bumps the constant and
@@ -34,8 +37,11 @@ from __future__ import annotations
 import json
 import socket
 import struct
+import zlib
 
 import numpy as np
+
+from .. import faults as F
 
 #: bump on any framing/semantics change; HELLO negotiates it
 PROTOCOL_VERSION = 1
@@ -71,6 +77,15 @@ class ProtocolError(RuntimeError):
     """Malformed frame or out-of-contract message sequence."""
 
 
+class ChecksumError(ProtocolError):
+    """BATCH payload failed its CRC32 — the frame arrived torn/corrupted.
+
+    Unlike other protocol errors this one is *recoverable by re-request*
+    (the server's reply is a pure function of ``(epoch, seq)``), so the
+    client rejects the batch and asks for the same seq again instead of
+    tearing the connection down."""
+
+
 def pack(msg_type: int, header: dict, payload: bytes = b"") -> bytes:
     h = json.dumps(header, separators=(",", ":")).encode()
     body_len = 1 + 4 + len(h) + len(payload)
@@ -80,8 +95,16 @@ def pack(msg_type: int, header: dict, payload: bytes = b"") -> bytes:
 
 
 def send_msg(sock: socket.socket, msg_type: int, header: dict,
-             payload: bytes = b"") -> None:
-    sock.sendall(pack(msg_type, header, payload))
+             payload: bytes = b"", *, site: str = None) -> None:
+    """Frame and send one message.  ``site`` names a fault-injection
+    point (docs/RESILIENCE.md): under an armed plan the framed bytes may
+    be delayed, torn mid-frame, corrupted, or replaced by a reset."""
+    frame = pack(msg_type, header, payload)
+    if site is not None:
+        rule = F.draw(site)
+        if rule is not None:
+            frame = F.apply_to_frame(rule, sock, frame)
+    sock.sendall(frame)
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -95,12 +118,18 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
-def recv_msg(sock: socket.socket):
+def recv_msg(sock: socket.socket, *, site: str = None):
     """Read one frame → ``(msg_type, header, payload)``.
 
     Raises ``ConnectionError`` on a clean or mid-frame close (the retry
     layer's signal to reconnect) and :class:`ProtocolError` on a frame
-    that cannot be parsed (never retried — the peer is broken)."""
+    that cannot be parsed (never retried — the peer is broken).  ``site``
+    names a fault-injection point: reset/delay fire before the read,
+    ``corrupt`` flips a byte of the received payload (which the CRC32
+    check in :func:`decode_indices` must then catch)."""
+    rule = F.draw(site) if site is not None else None
+    if rule is not None and rule.kind != "corrupt":
+        F.perform(rule)
     (body_len,) = struct.unpack("!I", _recv_exact(sock, 4))
     if not 5 <= body_len <= MAX_FRAME:
         raise ProtocolError(f"frame length {body_len} outside [5, {MAX_FRAME}]")
@@ -115,15 +144,22 @@ def recv_msg(sock: socket.socket):
     if not isinstance(header, dict):
         raise ProtocolError(f"header must be a JSON object, got "
                             f"{type(header).__name__}")
-    return msg_type, header, body[5 + hlen:]
+    payload = body[5 + hlen:]
+    if rule is not None and rule.kind == "corrupt":
+        payload = F.flip_byte(payload)
+    return msg_type, header, payload
 
 
 # ------------------------------------------------------- index batch codec
 def encode_indices(arr: np.ndarray):
     """``(header_fields, payload)`` for an index batch: raw bytes plus the
-    dtype string (with byte order) the receiver rebuilds from."""
+    dtype string (with byte order) the receiver rebuilds from, and a
+    CRC32 of the payload so a torn/corrupted frame that survives framing
+    cannot become silently wrong indices."""
     a = np.ascontiguousarray(arr)
-    return {"dtype": a.dtype.str, "count": int(a.shape[0])}, a.tobytes()
+    payload = a.tobytes()
+    return {"dtype": a.dtype.str, "count": int(a.shape[0]),
+            "crc32": zlib.crc32(payload) & 0xFFFFFFFF}, payload
 
 
 def decode_indices(header: dict, payload: bytes) -> np.ndarray:
@@ -136,6 +172,14 @@ def decode_indices(header: dict, payload: bytes) -> np.ndarray:
         raise ProtocolError(
             f"BATCH payload is {len(payload)} bytes; header promises "
             f"{count} x {dtype}"
+        )
+    crc = header.get("crc32")
+    if crc is not None and (zlib.crc32(payload) & 0xFFFFFFFF) != int(crc):
+        # absent crc32 is tolerated (pre-checksum peers within the same
+        # protocol version); a PRESENT mismatch is a corrupted payload
+        raise ChecksumError(
+            f"BATCH payload crc32 mismatch (header {int(crc)}); "
+            "rejecting the corrupted frame"
         )
     arr = np.frombuffer(payload, dtype=dtype)
     arr.setflags(write=False)  # frombuffer views are read-only anyway

@@ -38,9 +38,11 @@ import os
 import socket
 import threading
 import time
+import warnings
 from collections import OrderedDict
 from typing import Optional
 
+from .. import faults as F
 from ..utils.checkpoint import load_sampler_state, save_sampler_state
 from . import protocol as P
 from .metrics import ServiceMetrics
@@ -73,6 +75,7 @@ class IndexServer:
         snapshot_interval: int = 64,
         max_cached_arrays: Optional[int] = None,
         metrics: Optional[ServiceMetrics] = None,
+        clock=time.monotonic,
     ) -> None:
         if max_inflight < 1:
             raise ValueError(f"max_inflight must be >= 1, got {max_inflight}")
@@ -82,6 +85,9 @@ class IndexServer:
         self.heartbeat_timeout = float(heartbeat_timeout)
         self.snapshot_path = snapshot_path
         self.snapshot_interval = max(1, int(snapshot_interval))
+        #: lease time source — injectable so eviction timing is testable
+        #: against a fake clock (real deployments never override it)
+        self._clock = clock
         # current epoch + one behind: a client finishing epoch e while
         # another already moved to e+1 must not thrash regeneration
         self._max_cached = (
@@ -104,6 +110,8 @@ class IndexServer:
         self._next_conn_id = 0
         self._unsnapshotted = 0
         self._stop = threading.Event()
+        self._draining = threading.Event()
+        self._snapshot_error_warned = False
 
     # ------------------------------------------------------------ lifecycle
     def start(self) -> tuple[str, int]:
@@ -111,6 +119,8 @@ class IndexServer:
         bound ``(host, port)`` — pass ``port=0`` for an ephemeral port."""
         if self._listener is not None:
             raise RuntimeError("server already started")
+        self._stop.clear()
+        self._draining.clear()
         if self.snapshot_path and os.path.exists(self.snapshot_path):
             self._restore(load_sampler_state(self.snapshot_path))
         ls = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -130,15 +140,30 @@ class IndexServer:
     def address(self) -> tuple[str, int]:
         return self.host, self.port
 
-    def stop(self) -> None:
-        """Stop accepting, drop every connection, persist a snapshot."""
-        self._stop.set()
+    def stop(self, drain_s: float = 0.05) -> None:
+        """Graceful shutdown: drain, drop every connection, persist a
+        snapshot.
+
+        Drain phase: accepting stops and, for ``drain_s`` seconds,
+        requests still arriving on live connections are answered
+        ``ERROR(code='draining', retry_ms=...)`` — a structured "come
+        back shortly" the retry layer sleeps on, instead of a raw reset
+        racing the last reply.  Then every connection socket is shut down
+        and closed *before* the serve threads are joined, so a thread
+        blocked in ``recv`` wakes immediately and the join cannot leak
+        threads; any survivor past the join timeout is counted
+        (``leaked_threads``) and warned about rather than silently
+        abandoned."""
+        self._draining.set()
         ls, self._listener = self._listener, None
         if ls is not None:
             try:
                 ls.close()
             except OSError:
                 pass
+        if drain_s > 0 and not self._stop.is_set():
+            time.sleep(drain_s)
+        self._stop.set()
         with self._lock:
             socks = list(self._conn_socks.values())
         for s in socks:
@@ -152,6 +177,14 @@ class IndexServer:
                 pass
         for t in self._threads:
             t.join(timeout=5.0)
+        leaked = [t for t in self._threads if t.is_alive()]
+        if leaked:
+            self.metrics.inc("leaked_threads", value=len(leaked))
+            warnings.warn(
+                f"IndexServer.stop(): {len(leaked)} serve thread(s) "
+                f"survived the join timeout: "
+                f"{[t.name for t in leaked]}", RuntimeWarning,
+            )
         self._threads.clear()
         self._write_snapshot(force=True)
 
@@ -205,7 +238,23 @@ class IndexServer:
             if not force and self._unsnapshotted < self.snapshot_interval:
                 return
             self._unsnapshotted = 0
-        save_sampler_state(self.snapshot_path, self._state_dict())
+        state = self._state_dict()
+        try:
+            F.fire("server.snapshot_write")
+            save_sampler_state(self.snapshot_path, state)
+        except OSError as exc:
+            # The snapshot is operational state, never a correctness
+            # dependency (streams are pure functions of the spec) — a
+            # full/unwritable disk must degrade observably, not take the
+            # serving path down with it.
+            self.metrics.inc("snapshot_errors")
+            if not self._snapshot_error_warned:
+                self._snapshot_error_warned = True
+                warnings.warn(
+                    f"IndexServer: snapshot write to "
+                    f"{self.snapshot_path!r} failed ({exc!r}); serving "
+                    "continues without persistence", RuntimeWarning,
+                )
 
     # ------------------------------------------------------------ the cache
     def _rank_array(self, epoch: int, rank: int):
@@ -246,12 +295,16 @@ class IndexServer:
                 name=f"psds-service-conn-{conn_id}",
             )
             t.start()
+            # prune finished serve threads while appending: a long-lived
+            # daemon churning reconnects must not accumulate dead Thread
+            # objects (and stop() must not re-join them)
+            self._threads = [x for x in self._threads if x.is_alive()]
             self._threads.append(t)
 
     def _sweep_leases(self) -> None:
         """Evict ranks whose connection went silent past the lease timeout
         and close their sockets (frees the rank AND unblocks the reader)."""
-        now = time.monotonic()
+        now = self._clock()
         to_close = []
         with self._lock:
             for rank, lease in self._leases.items():
@@ -285,11 +338,14 @@ class IndexServer:
                         pass
                     return
                 try:
+                    F.fire("server.dispatch")
                     self._dispatch(sock, conn_id, msg, header, payload)
                 except OSError:
                     return  # peer vanished mid-reply
         except (ConnectionError, OSError):
             return
+        except F.InjectedThreadDeath:
+            return  # injected serve-thread death; cleanup below still runs
         finally:
             self._release_conn(conn_id)
             try:
@@ -307,7 +363,7 @@ class IndexServer:
                     lease["owner"] = None
 
     def _touch(self, rank: int, lease: dict) -> None:
-        now = time.monotonic()
+        now = self._clock()
         if now - lease["last_seen"] > self.heartbeat_timeout:
             # the client went silent past the lease but came back before
             # anything evicted it — a heartbeat gap worth counting
@@ -315,6 +371,16 @@ class IndexServer:
         lease["last_seen"] = now
 
     def _dispatch(self, sock, conn_id, msg, header, payload) -> None:
+        if self._draining.is_set():
+            # graceful drain: answer every request arriving during the
+            # stop() window with a structured "retry shortly" instead of
+            # letting the imminent socket close read as a raw reset
+            P.send_msg(sock, P.MSG_ERROR, {
+                "code": "draining",
+                "detail": "server is stopping; reconnect shortly",
+                "retry_ms": 200,
+            })
+            return
         if msg == P.MSG_HELLO:
             self._on_hello(sock, conn_id, header)
         elif msg == P.MSG_GET_BATCH:
@@ -379,7 +445,7 @@ class IndexServer:
             return
         want = header.get("rank", -1)
         want = -1 if want is None else int(want)
-        now = time.monotonic()
+        now = self._clock()
         with self._lock:
             rank = self._claim_rank(want, conn_id, now)
             if rank is None:

@@ -1,0 +1,471 @@
+"""Deterministic fault-injection matrix for the served-index stack.
+
+The contract under test (docs/RESILIENCE.md): for every fault site and
+every stream mode, the consumer sees either a bit-identical stream or a
+typed error within its deadline — never a hang, never silently-wrong
+indices.  Every test asserts ``plan.fired(...) > 0``: a chaos test whose
+fault never fired is vacuous and must fail.
+
+These run inside tier-1 (they are fast and fully deterministic) and are
+also the first leg of the ``make chaos-smoke`` gate (``-m chaos``).
+"""
+
+from __future__ import annotations
+
+import random
+import socket
+import threading
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+from partiallyshuffledistributedsampler_tpu import faults as F
+from partiallyshuffledistributedsampler_tpu.ops.mixture import MixtureSpec
+from partiallyshuffledistributedsampler_tpu.sampler.host_loader import (
+    HostDataLoader,
+)
+from partiallyshuffledistributedsampler_tpu.service import (
+    IndexServer,
+    PartialShuffleSpec,
+    ServiceIndexClient,
+)
+from partiallyshuffledistributedsampler_tpu.service import protocol as P
+from partiallyshuffledistributedsampler_tpu.service.client import (
+    ServiceUnavailable,
+)
+from partiallyshuffledistributedsampler_tpu.utils import (
+    RetryPolicy,
+    StallError,
+)
+
+pytestmark = pytest.mark.chaos
+
+
+# ----------------------------------------------------------- stream builders
+def plain_spec(world=1):
+    return PartialShuffleSpec.plain(530, window=32, seed=7, world=world)
+
+
+def mixture_spec(world=1):
+    ms = MixtureSpec([100, 200, 50], [5, 3, 2], block=16)
+    return PartialShuffleSpec.mixture(ms, seed=3, world=world,
+                                      epoch_samples=300)
+
+
+def shard_spec(world=1):
+    return PartialShuffleSpec.shard([17, 5, 29, 11, 40, 8, 23, 9], window=4,
+                                    seed=9, world=world,
+                                    within_shard_shuffle=True)
+
+
+SPECS = {"plain": plain_spec, "mixture": mixture_spec, "shard": shard_spec}
+
+
+def make_loader(mode, **kw):
+    """A small HostDataLoader in each stream mode (world=1, rank 0)."""
+    if mode == "plain":
+        X = np.arange(530, dtype=np.int64)
+        return HostDataLoader(X, window=32, batch=64, seed=7, rank=0,
+                              world=1, **kw)
+    if mode == "mixture":
+        ms = MixtureSpec([100, 200, 50], [5, 3, 2], block=16)
+        data = [np.arange(100, dtype=np.int64),
+                np.arange(200, dtype=np.int64),
+                np.arange(50, dtype=np.int64)]
+        return HostDataLoader(data, mixture=ms, epoch_samples=300, batch=64,
+                              seed=3, rank=0, world=1, **kw)
+    sizes = [17, 5, 29, 11, 40, 8, 23, 9]
+    X = np.arange(sum(sizes), dtype=np.int64)
+    return HostDataLoader(X, shard_sizes=sizes, window=4, batch=32, seed=9,
+                          rank=0, world=1, **kw)
+
+
+def collect(loader, epoch=0):
+    return [np.asarray(b) for b in loader.epoch(epoch)]
+
+
+def _raw_hello(addr, rank, batch=32):
+    sock = socket.create_connection(addr, timeout=5.0)
+    P.send_msg(sock, P.MSG_HELLO,
+               {"proto": P.PROTOCOL_VERSION, "rank": rank, "batch": batch})
+    msg, header, _ = P.recv_msg(sock)
+    return sock, msg, header
+
+
+# ------------------------------------------------------- plan/rule mechanics
+def test_fault_rule_validation():
+    with pytest.raises(ValueError):
+        F.FaultRule(site="nope", kind="error")
+    with pytest.raises(ValueError):
+        F.FaultRule(site="loader.regen", kind="nope")
+    with pytest.raises(ValueError):
+        F.FaultRule(site="loader.regen", kind="error", nth=0)
+    with pytest.raises(ValueError):
+        F.FaultRule(site="loader.regen", kind="error", every=0)
+
+
+def test_fault_plan_counters_are_deterministic():
+    def run():
+        plan = F.FaultPlan([F.FaultRule(site="loader.regen", kind="error",
+                                        nth=2, every=3, count=2)])
+        return [plan.draw("loader.regen") is not None for _ in range(12)]
+
+    a, b = run(), run()
+    assert a == b
+    # nth=2, every=3, count=2 -> fires at exactly hits 2 and 5
+    assert [i + 1 for i, fired in enumerate(a) if fired] == [2, 5]
+
+
+def test_fault_plan_probabilistic_is_seed_reproducible():
+    def run(seed):
+        plan = F.FaultPlan([F.FaultRule(site="loader.regen", kind="error",
+                                        p=0.5, count=0)], seed=seed)
+        return [plan.draw("loader.regen") is not None for _ in range(64)]
+
+    assert run(1) == run(1)
+    assert run(1) != run(2)
+    assert 0 < sum(run(3)) < 64  # actually probabilistic, not all-or-nothing
+
+
+def test_fault_plan_json_and_env_roundtrip(monkeypatch):
+    plan = F.FaultPlan([F.FaultRule(site="service.send", kind="torn_frame",
+                                    nth=3)], seed=5)
+    back = F.FaultPlan.from_json(plan.to_json())
+    assert back.rules == plan.rules and back.seed == plan.seed
+    monkeypatch.setenv("PSDS_FAULT_PLAN", plan.to_json())
+    env_plan = F.FaultPlan.from_env()
+    assert env_plan is not None and env_plan.rules == plan.rules
+    monkeypatch.delenv("PSDS_FAULT_PLAN")
+    assert F.FaultPlan.from_env() is None
+
+
+def test_plans_nest_lifo_and_unarmed_draw_is_none():
+    assert F.draw("loader.regen") is None  # fast path: no plan, no effect
+    outer = F.FaultPlan([F.FaultRule(site="loader.regen", kind="error")])
+    inner = F.FaultPlan([F.FaultRule(site="loader.prefetch", kind="delay")])
+    with outer:
+        assert F.active() is outer
+        with inner:
+            assert F.active() is inner
+        assert F.active() is outer
+    assert F.draw("loader.regen") is None
+
+
+# ------------------------------------------------------------- retry policy
+class FakeTime:
+    def __init__(self):
+        self.t = 0.0
+        self.sleeps = []
+
+    def clock(self):
+        return self.t
+
+    def sleep(self, s):
+        self.sleeps.append(s)
+        self.t += s
+
+
+def test_retry_backoff_jitter_stays_inside_envelope():
+    ft = FakeTime()
+    pol = RetryPolicy(base=0.1, max_delay=0.4, deadline=None,
+                      clock=ft.clock, sleep=ft.sleep,
+                      rng=random.Random(0))
+    for k in range(10):
+        d = pol.backoff(k)
+        assert 0.0 <= d <= min(0.4, 0.1 * 2.0 ** k)
+
+
+def test_retry_deadline_refuses_to_oversleep():
+    ft = FakeTime()
+    pol = RetryPolicy(base=0.1, max_delay=0.4, deadline=1.0,
+                      clock=ft.clock, sleep=ft.sleep,
+                      rng=random.Random(1))
+    op = pol.begin()
+    while op.pause():
+        pass
+    assert ft.t <= 1.0  # never slept past the operation deadline
+    assert op.attempts >= 1
+
+
+def test_retry_budget_caps_attempts():
+    ft = FakeTime()
+    pol = RetryPolicy(base=0.0, max_delay=0.0, deadline=None, budget=3,
+                      clock=ft.clock, sleep=ft.sleep)
+    op = pol.begin()
+    assert [op.pause() for _ in range(4)] == [True, True, True, False]
+
+
+def test_retry_pause_honors_server_suggested_minimum():
+    ft = FakeTime()
+    pol = RetryPolicy(base=0.0, max_delay=0.0, deadline=None,
+                      clock=ft.clock, sleep=ft.sleep)
+    op = pol.begin()
+    assert op.pause(min_delay=0.2)
+    assert ft.sleeps == [0.2]
+
+
+def test_circuit_breaker_open_halfopen_reopen_close():
+    ft = FakeTime()
+    pol = RetryPolicy(breaker_threshold=2, breaker_reset=1.0,
+                      clock=ft.clock, sleep=ft.sleep)
+    assert pol.allow()
+    pol.record_failure()
+    assert pol.allow()  # below threshold
+    pol.record_failure()
+    assert not pol.allow()  # open
+    ft.t += 1.0
+    assert pol.allow()  # half-open probe admitted
+    pol.record_failure()
+    assert not pol.allow()  # failed probe re-opens a fresh interval
+    ft.t += 1.0
+    assert pol.allow()
+    pol.record_success()
+    assert pol.allow() and not pol.circuit_open  # closed
+
+
+# ------------------------------------------------- service-side fault matrix
+# (site, kind, rule kwargs) — nth skips the handshake so faults land mid-
+# stream; counts are finite so every scenario must terminate
+_SERVICE_FAULTS = [
+    ("service.send", "torn_frame", dict(nth=2, count=1)),
+    ("service.send", "reset", dict(nth=2, count=1)),
+    ("service.send", "delay", dict(nth=2, count=2, delay_s=0.01)),
+    ("service.recv", "reset", dict(nth=2, count=1)),
+    ("service.recv", "corrupt", dict(nth=2, count=1)),
+    ("server.dispatch", "thread_death", dict(nth=2, count=1)),
+    ("server.snapshot_write", "disk_full", dict(nth=1, count=2)),
+]
+
+
+@pytest.mark.parametrize("mode", sorted(SPECS))
+@pytest.mark.parametrize(
+    "site,kind,rule_kw", _SERVICE_FAULTS,
+    ids=[f"{s}-{k}" for s, k, _ in _SERVICE_FAULTS])
+def test_service_fault_matrix_stream_bit_identical(mode, site, kind, rule_kw,
+                                                   tmp_path):
+    spec = SPECS[mode](world=1)
+    ref = np.asarray(spec.rank_indices(1, 0))
+    plan = F.FaultPlan([F.FaultRule(site=site, kind=kind, **rule_kw)])
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        with plan:
+            with IndexServer(spec, snapshot_path=str(tmp_path / "snap.json"),
+                             snapshot_interval=1) as srv:
+                with ServiceIndexClient(srv.address, rank=0, batch=37,
+                                        backoff_base=0.01,
+                                        reconnect_timeout=10.0) as client:
+                    got = client.epoch_indices(1)
+    assert plan.fired(site) > 0, "fault never fired; the test is vacuous"
+    assert np.array_equal(got, ref), f"stream diverged under {kind} at {site}"
+    if kind == "corrupt":
+        counters = client.metrics.report()["counters"]
+        assert counters.get("checksum_rejects", 0) >= 1
+    if kind == "disk_full":
+        assert srv.metrics.report()["counters"].get("snapshot_errors", 0) >= 1
+
+
+def test_persistent_corruption_is_a_typed_error():
+    spec = plain_spec(world=1)
+    # every reply corrupted: re-requesting cannot help; the client must
+    # give up with the typed checksum error, not loop forever
+    plan = F.FaultPlan([F.FaultRule(site="service.recv", kind="corrupt",
+                                    count=0)])
+    t0 = time.monotonic()
+    with IndexServer(spec) as srv, plan:
+        with ServiceIndexClient(srv.address, rank=0, batch=37) as client:
+            with pytest.raises(P.ChecksumError):
+                client.epoch_indices(1)
+    assert plan.fired("service.recv") >= 2
+    assert time.monotonic() - t0 < 10.0
+
+
+# --------------------------------------------------- loader-side fault matrix
+@pytest.mark.parametrize("mode", sorted(SPECS))
+def test_loader_prefetch_delay_stream_identical(mode):
+    ref = collect(make_loader(mode))
+    plan = F.FaultPlan([F.FaultRule(site="loader.prefetch", kind="delay",
+                                    nth=2, count=2, delay_s=0.01)])
+    with plan:
+        got = collect(make_loader(mode))
+    assert plan.fired("loader.prefetch") > 0
+    assert len(got) == len(ref)
+    for a, b in zip(got, ref):
+        assert np.array_equal(a, b)
+
+
+@pytest.mark.parametrize("mode", sorted(SPECS))
+def test_loader_prefetch_thread_death_raises_stall(mode):
+    loader = make_loader(mode, stall_timeout=2.0)
+    plan = F.FaultPlan([F.FaultRule(site="loader.prefetch",
+                                    kind="thread_death", nth=2)])
+    t0 = time.monotonic()
+    with plan:
+        with pytest.raises(StallError) as ei:
+            collect(loader)
+    assert plan.fired("loader.prefetch") == 1
+    assert time.monotonic() - t0 < 10.0  # typed error, not a hang
+    assert ei.value.thread_alive is False
+
+
+@pytest.mark.parametrize("mode", sorted(SPECS))
+def test_loader_regen_fault_is_typed(mode):
+    loader = make_loader(mode)
+    with F.FaultPlan([F.FaultRule(site="loader.regen",
+                                  kind="error")]) as plan:
+        with pytest.raises(F.InjectedFault) as ei:
+            loader.epoch_indices(0)
+    assert plan.fired("loader.regen") == 1
+    assert ei.value.site == "loader.regen"
+
+
+def test_loader_stall_watchdog_on_wedged_producer():
+    """A producer wedged (not dead) past stall_timeout surfaces a
+    StallError embedding the stuck thread's stack."""
+    loader = make_loader("plain", stall_timeout=0.3)
+    plan = F.FaultPlan([F.FaultRule(site="loader.prefetch", kind="delay",
+                                    nth=1, count=1, delay_s=1.5)])
+    with plan:
+        with pytest.raises(StallError) as ei:
+            collect(loader)
+    assert plan.fired("loader.prefetch") == 1
+    assert ei.value.thread_alive is True
+    assert "stack of stalled thread" in str(ei.value)
+
+
+# -------------------------------------------------- degraded mode + re-attach
+def test_degraded_fallback_mid_epoch_then_reattach():
+    X = np.arange(530, dtype=np.int64)
+    local = HostDataLoader(X, window=32, batch=64, seed=7, rank=0, world=1)
+    with IndexServer(plain_spec(world=1)) as srv:
+        client = ServiceIndexClient(srv.address, rank=0, batch=37,
+                                    backoff_base=0.01,
+                                    reconnect_timeout=0.3)
+        loader = HostDataLoader(X, window=32, batch=64, seed=7, rank=0,
+                                world=1, index_client=client,
+                                reattach_interval=0.05)
+        try:
+            # healthy epoch first: service stream == local stream
+            assert np.array_equal(loader.epoch_indices(0),
+                                  local.epoch_indices(0))
+            assert not loader.degraded
+            # now every reply resets: the daemon is effectively dead
+            # mid-epoch, past the client's reconnect deadline
+            plan = F.FaultPlan([F.FaultRule(site="service.recv",
+                                            kind="reset", count=0)])
+            with plan:
+                with warnings.catch_warnings(record=True) as caught:
+                    warnings.simplefilter("always")
+                    got = loader.epoch_indices(1)
+            assert plan.fired("service.recv") > 0
+            assert loader.degraded
+            assert any("index service unavailable" in str(w.message)
+                       for w in caught)
+            assert np.array_equal(got, local.epoch_indices(1))
+            counters = client.metrics.report()["counters"]
+            assert counters.get("degraded_mode", 0) >= 1
+            # the daemon is healthy again (plan disarmed): past the
+            # re-attach interval the next epoch probes and re-attaches
+            time.sleep(0.06)
+            back = loader.epoch_indices(2)
+            assert not loader.degraded
+            assert np.array_equal(back, local.epoch_indices(2))
+            counters = client.metrics.report()["counters"]
+            assert counters.get("reattached", 0) >= 1
+        finally:
+            client.close()
+
+
+def test_degraded_fallback_off_raises_typed_error():
+    X = np.arange(530, dtype=np.int64)
+    srv = IndexServer(plain_spec(world=1))
+    srv.start()
+    client = ServiceIndexClient(srv.address, rank=0, batch=37,
+                                backoff_base=0.01, reconnect_timeout=0.2)
+    loader = HostDataLoader(X, window=32, batch=64, seed=7, rank=0,
+                            world=1, index_client=client,
+                            degraded_fallback=False)
+    try:
+        assert loader.epoch_indices(0) is not None
+        srv.stop()
+        with pytest.raises(ServiceUnavailable):
+            loader.epoch_indices(1)
+        assert not loader.degraded
+    finally:
+        client.close()
+
+
+# ----------------------------------------------------------- graceful drain
+def test_drain_replies_structured_error_then_stop_leaks_no_threads():
+    srv = IndexServer(plain_spec(world=1))
+    srv.start()
+    sock, msg, _ = _raw_hello(srv.address, rank=0)
+    try:
+        assert msg == P.MSG_WELCOME
+        srv._draining.set()  # the stop() drain window, held open
+        P.send_msg(sock, P.MSG_GET_BATCH,
+                   {"rank": 0, "epoch": 0, "seq": 0, "ack": -1})
+        msg, header, _ = P.recv_msg(sock)
+        assert msg == P.MSG_ERROR and header["code"] == "draining"
+        assert header["retry_ms"] > 0
+    finally:
+        sock.close()
+    srv.stop()
+    alive = [t.name for t in threading.enumerate()
+             if t.name.startswith("psds-service") and t.is_alive()]
+    assert not alive, f"stop() leaked serve threads: {alive}"
+
+
+def test_client_survives_drain_window_across_restart():
+    """A stop() with a long drain window answers in-flight requests with
+    ``draining`` and the retrying client completes bit-identically once
+    the server is back."""
+    spec = plain_spec(world=1)
+    ref = np.asarray(spec.rank_indices(1, 0))
+    srv = IndexServer(spec)
+    srv.start()
+    client = ServiceIndexClient(srv.address, rank=0, batch=37,
+                                backoff_base=0.01, reconnect_timeout=10.0)
+    got = []
+
+    def bounce():
+        srv.stop(drain_s=0.2)
+        srv.start()  # same instance re-binds the same port
+
+    try:
+        it = client.epoch_batches(1)
+        for _ in range(3):
+            got.append(next(it))
+        bouncer = threading.Thread(target=bounce)
+        bouncer.start()
+        time.sleep(0.05)  # land the next requests inside the drain window
+        got.extend(it)  # rides draining replies, reconnects, finishes
+        bouncer.join()
+    finally:
+        client.close()
+        srv.stop()
+    assert np.array_equal(np.concatenate(got), ref)
+
+
+# ---------------------------------------------------------- snapshot faults
+def test_snapshot_disk_full_does_not_stop_serving(tmp_path):
+    spec = plain_spec(world=1)
+    ref = np.asarray(spec.rank_indices(0, 0))
+    plan = F.FaultPlan([F.FaultRule(site="server.snapshot_write",
+                                    kind="disk_full", count=0)])
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        with plan:
+            with IndexServer(spec, snapshot_path=str(tmp_path / "s.json"),
+                             snapshot_interval=1) as srv:
+                with ServiceIndexClient(srv.address, rank=0,
+                                        batch=37) as client:
+                    got = client.epoch_indices(0)
+    assert plan.fired("server.snapshot_write") >= 1
+    assert np.array_equal(got, ref)
+    assert srv.metrics.report()["counters"].get("snapshot_errors", 0) >= 1
+    # warned exactly once, not once per failed write
+    snap_warnings = [w for w in caught
+                     if "snapshot write" in str(w.message)]
+    assert len(snap_warnings) == 1

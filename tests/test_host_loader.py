@@ -131,6 +131,37 @@ def test_gather_error_surfaces_to_consumer():
         list(loader.epoch(0))
 
 
+def test_gather_error_keeps_original_traceback_under_full_queue():
+    """Regression: the producer hits an error while the queue is FULL
+    (consumer asleep, depth=1) — the error must still reach the consumer
+    carrying the producer's original traceback, not a re-wrapped one."""
+    import time
+
+    from partiallyshuffledistributedsampler_tpu import faults as F
+
+    loader = make(depth=1)
+    plan = F.FaultPlan([F.FaultRule(site="loader.prefetch", kind="error",
+                                    nth=3)])
+    with plan:
+        it = loader.epoch(0)
+        next(it)  # start the producer
+        # producer: batch 2 queued (queue full), then the injected error
+        # at step 3 must wait for queue space behind it
+        time.sleep(0.3)
+        with pytest.raises(F.InjectedFault) as ei:
+            for _ in it:
+                pass
+    assert plan.fired("loader.prefetch") == 1
+    names = []
+    tb = ei.value.__traceback__
+    while tb is not None:
+        names.append(tb.tb_frame.f_code.co_name)
+        tb = tb.tb_next
+    # the producer's frames survived the thread hop
+    assert "produce" in names, names
+    assert "perform" in names, names
+
+
 def test_validation_errors():
     with pytest.raises(ValueError, match="leading dims"):
         make(data={"x": np.arange(10), "y": np.arange(11)})

@@ -240,6 +240,43 @@ def test_heartbeat_timeout_evicts_silent_client():
     assert srv.metrics.report()["counters"].get("evictions", 0) >= 1
 
 
+def test_lease_eviction_timing_exact_with_injected_clock():
+    """Eviction timing pinned down deterministically: a lease is held
+    through exactly ``heartbeat_timeout`` of silence and reclaimable
+    immediately past it — no sleeps, the server runs on a fake clock."""
+    class FakeClock:
+        def __init__(self):
+            self.t = 1000.0
+
+        def __call__(self):
+            return self.t
+
+    clk = FakeClock()
+    spec = plain_spec(world=1)
+    with IndexServer(spec, heartbeat_timeout=10.0, clock=clk) as srv:
+        holder, msg, _ = _raw_hello(srv.address, rank=0)
+        try:
+            assert msg == P.MSG_WELCOME
+            # silence for EXACTLY the ttl: still leased (eviction is
+            # strictly-greater-than, so a heartbeat landing on the
+            # deadline keeps its lease)
+            clk.t += 10.0
+            srv._sweep_leases()
+            rival, msg, header = _raw_hello(srv.address, rank=0)
+            rival.close()
+            assert msg == P.MSG_ERROR and header["code"] == "rank_taken"
+            assert srv.metrics.report()["counters"].get("evictions", 0) == 0
+            # one tick past the ttl: swept, counted, and reclaimable
+            clk.t += 0.001
+            srv._sweep_leases()
+            assert srv.metrics.report()["counters"].get("evictions", 0) == 1
+            fresh, msg, _ = _raw_hello(srv.address, rank=0)
+            fresh.close()
+            assert msg == P.MSG_WELCOME
+        finally:
+            holder.close()
+
+
 def test_heartbeat_keeps_lease_alive():
     spec = plain_spec(world=1)
     with IndexServer(spec, heartbeat_timeout=0.4) as srv:
