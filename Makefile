@@ -24,10 +24,15 @@
 #                          + benchmarks/chaos_smoke.py — server kill/
 #                          restart recovery and degraded-mode fallback,
 #                          streams asserted bit-identical throughout
+#   * elastic smoke        tests/test_elastic_service.py (`-m elastic`)
+#                          + benchmarks/elastic_smoke.py — mid-epoch
+#                          resharding: barrier/first-batch latency, the
+#                          exactly-once union law asserted throughout
 
 PY ?= python
 
-.PHONY: check test bench native dryrun service-smoke chaos-smoke
+.PHONY: check test bench native dryrun service-smoke chaos-smoke \
+	elastic-smoke
 
 # the driver parses the LAST line of bench.py's combined output (round 3
 # lost its headline to the details line — BENCH_r03.json "parsed": null),
@@ -68,6 +73,13 @@ service-smoke:
 chaos-smoke:
 	$(PY) -m pytest tests/test_chaos.py -q -m chaos -ra
 	$(PY) benchmarks/chaos_smoke.py
+
+# elastic-membership gate (docs/SERVICE.md "Elastic membership"): the
+# reshard/leave/eviction suite (exactly-once across world changes, snap-
+# shot v2 resume, degraded composition), then the barrier-latency smoke
+elastic-smoke:
+	$(PY) -m pytest tests/test_elastic_service.py -q -m elastic -ra
+	$(PY) benchmarks/elastic_smoke.py
 
 native:
 	$(MAKE) -C csrc

@@ -279,6 +279,18 @@ def main() -> None:
         except Exception as exc:
             details["chaos_error"] = repr(exc)[:200]
 
+    # detail tier: elastic membership — mid-epoch reshard barrier latency
+    # and post-reshard first-batch latency, one shrink + one growth
+    # (methodology in benchmarks/elastic_smoke.py)
+    if not smoke:
+        try:
+            sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+            from benchmarks.elastic_smoke import summarize as elastic_summarize
+
+            details["elastic"] = elastic_summarize()
+        except Exception as exc:
+            details["elastic_error"] = repr(exc)[:200]
+
     print(json.dumps(details), file=sys.stderr, flush=True)
     if not metric_printed:
         raise SystemExit("no backend produced a timing")

@@ -1,0 +1,127 @@
+"""Elastic-membership smoke + latency harness for the served-index stack.
+
+Two consumers:
+
+* ``make elastic-smoke`` / ``python benchmarks/elastic_smoke.py`` — the
+  CI gate: reshard a live :class:`IndexServer` mid-epoch (one shrink,
+  one growth) and assert the exactly-once union law — pre-barrier
+  batches to the old ranks plus post-barrier batches to the new ranks
+  equal the uninterrupted epoch stream, modulo the new partition's
+  wrap-padding.  Exit 0 and one JSON line on success; raises loudly on
+  any miss.
+
+* ``bench.py`` imports :func:`summarize` — the ``details["elastic"]``
+  tier: *barrier latency* (RESHARD request → commit, ms; the freeze +
+  watermark collection + §6 layer append, all ranks already drained)
+  and *post-reshard first-batch latency* (commit → first batch of the
+  new partition delivered, ms; the ``resharded`` adopt + re-request).
+
+Both figures describe the membership coordinator (docs/SERVICE.md,
+"Elastic membership"), not the network: everything runs on loopback.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from collections import Counter
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _reshard_latency_ms(old_world: int, new_world: int, *, n: int = 20_000,
+                        window: int = 128, batch: int = 256) -> dict:
+    """One mid-epoch world change ``old_world -> new_world`` with every
+    rank sitting at an equal watermark (so the barrier commits inside
+    the RESHARD request — the timed path is pure coordinator).  Every
+    delivered batch is collected and the union law asserted."""
+    from partiallyshuffledistributedsampler_tpu.service import (
+        IndexServer,
+        PartialShuffleSpec,
+        ServiceIndexClient,
+    )
+
+    spec = PartialShuffleSpec.plain(n, window=window, seed=0,
+                                    world=old_world)
+    ref = np.concatenate([np.asarray(spec.rank_indices(0, r))
+                          for r in range(old_world)])
+    srv = IndexServer(spec)
+    addr = srv.start()
+    clients = [ServiceIndexClient(addr, rank=r, batch=batch,
+                                  backoff_base=0.02, reconnect_timeout=10.0)
+               for r in range(old_world)]
+    delivered = []
+    joiners = []
+    try:
+        its = [c.epoch_batches(0) for c in clients]
+        for it in its:
+            delivered.append(next(it))
+            delivered.append(next(it))
+        t0 = time.perf_counter()
+        rep = clients[0].reshard(new_world)
+        barrier_ms = (time.perf_counter() - t0) * 1e3
+        if rep["committed"] is not True:
+            raise AssertionError(
+                "equal watermarks must commit inside the trigger")
+        t1 = time.perf_counter()
+        first = next(its[0])  # adopts `resharded`, re-requests at gen+1
+        first_batch_ms = (time.perf_counter() - t1) * 1e3
+        delivered.append(first)
+        for r in range(min(old_world, new_world)):
+            delivered.extend(its[r])  # survivors ride through
+        for r in range(new_world, old_world):
+            leftover = list(its[r])  # displaced: bows out empty
+            if leftover:
+                raise AssertionError(
+                    f"displaced rank {r} kept receiving batches")
+        for _ in range(max(0, new_world - old_world)):
+            j = ServiceIndexClient(addr, rank=None, batch=batch,
+                                   backoff_base=0.02,
+                                   reconnect_timeout=10.0)
+            joiners.append(j)
+            delivered.extend(j.epoch_batches(0))
+    finally:
+        for c in clients + joiners:
+            c.close()
+        srv.stop()
+    union = Counter(np.concatenate(delivered).tolist())
+    full = Counter(ref.tolist())
+    missing = full - union
+    if missing:
+        raise AssertionError(
+            f"dropped epoch values: {list(missing.items())[:8]}")
+    n_extra = sum((union - full).values())
+    if n_extra > new_world:
+        raise AssertionError(
+            f"{n_extra} extras exceed the wrap-pad allowance {new_world}")
+    return {
+        "barrier_ms": round(barrier_ms, 3),
+        "first_batch_ms": round(first_batch_ms, 3),
+        "old_world": old_world, "new_world": new_world,
+        "wrap_pad_extras": n_extra,
+    }
+
+
+def summarize(**kw) -> dict:
+    """The bench.py ``details["elastic"]`` tier: one shrink, one growth."""
+    return {
+        "shrink": _reshard_latency_ms(4, 3, **kw),
+        "grow": _reshard_latency_ms(3, 5, **kw),
+    }
+
+
+def main() -> None:
+    """The `make elastic-smoke` gate: both directions, hard assertions."""
+    out = summarize()
+    for leg in ("shrink", "grow"):
+        assert out[leg]["barrier_ms"] > 0
+        assert out[leg]["first_batch_ms"] > 0
+    print(json.dumps({"elastic_smoke": "ok", **out}))
+
+
+if __name__ == "__main__":
+    main()

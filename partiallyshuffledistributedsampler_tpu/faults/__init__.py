@@ -13,6 +13,8 @@ Vocabulary:
   framework (:data:`SITES`): ``service.send`` / ``service.recv`` (the
   client's wire ops), ``server.dispatch`` (one request on a serve
   thread), ``server.snapshot_write`` (the daemon's snapshot persist),
+  ``server.reshard`` (an elastic barrier freezing / committing),
+  ``client.leave`` (a client announcing its preemption drain),
   ``loader.prefetch`` (one step of the gather thread), ``loader.regen``
   (local epoch index generation).
 * A **fault kind** is what happens when a rule fires (:data:`KINDS`):

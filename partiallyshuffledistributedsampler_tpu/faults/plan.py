@@ -21,6 +21,8 @@ SITES = frozenset({
     "service.recv",          # server → client wire op (reply frames)
     "server.dispatch",       # one request on a daemon serve thread
     "server.snapshot_write", # the daemon persisting its snapshot
+    "server.reshard",        # a reshard barrier freezing / committing
+    "client.leave",          # a client announcing its preemption drain
     "loader.prefetch",       # one step of HostDataLoader's gather thread
     "loader.regen",          # local epoch index generation
 })

@@ -24,6 +24,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+try:  # jax >= 0.4.35 re-exports it at top level
+    from jax import shard_map
+except ImportError:  # older jax: experimental namespace only
+    from jax.experimental.shard_map import shard_map
+
 from ..ops import core
 from ..ops.xla import build_evaluator
 
@@ -63,8 +68,6 @@ def _compiled_sharded(
             agreed[0], agreed[1], agreed[2], rank.astype(jnp.uint32),
         ])
         return evaluator(sv)[None, :]
-
-    from jax import shard_map
 
     fn = shard_map(
         per_device,
@@ -144,8 +147,6 @@ def _compiled_sharded_elastic(
             shuffle=shuffle, order_windows=order_windows, rounds=rounds,
         )
         return out[None, :]
-
-    from jax import shard_map
 
     fn = shard_map(
         per_device,
@@ -276,8 +277,6 @@ def _compiled_sharded_mixture(
         )
         return out[None, :]
 
-    from jax import shard_map
-
     fn = shard_map(
         per_device,
         mesh=mesh,
@@ -392,8 +391,6 @@ def _compiled_sharded_mixture_elastic(
             partition=partition, rounds=rounds,
         )
         return out[None, :]
-
-    from jax import shard_map
 
     fn = shard_map(
         per_device,

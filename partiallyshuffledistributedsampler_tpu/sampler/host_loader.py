@@ -20,7 +20,8 @@ Every stream the framework serves rides through the same loader:
   order windowed-shuffled, expanded to sample indices per epoch),
 * the **elastic remainder** epoch after a world-size change
   (``epoch(e, layers=[(old_world, consumed), ...])``, SPEC.md §6 — for
-  the single-source and mixture streams).
+  all three stream kinds; on the service path world changes are
+  server-driven instead, see docs/RESILIENCE.md "Elastic membership").
 
 Determinism: batches are exactly the corresponding sampler stream cut into
 ``batch``-sized slices — bit-identical to every other consumer surface of
@@ -88,14 +89,20 @@ class HostDataLoader:
         it locally (docs/SERVICE.md).  The stream is bit-identical to the
         local path by construction (the daemon evaluates the same
         ``PartialShuffleSpec`` this loader builds), so checkpoints
-        interoperate; elastic ``layers`` are a local-sampler feature and
-        raise on the service path.
+        interoperate.  Explicit elastic ``layers`` are a local-sampler
+        feature and raise on the service path — on that path the world
+        change is *server-driven* (docs/RESILIENCE.md "Elastic
+        membership"): when the daemon reshards mid-epoch the client rides
+        through it and this loader keeps serving batches transparently.
     degraded_fallback: served-stream resilience (docs/RESILIENCE.md).
         When the daemon stays unreachable past the client's
         ``reconnect_timeout``, compute the epoch locally from the same
         spec instead of failing the epoch — the fingerprint handshake
         guarantees the fallback stream is bit-identical to what the
-        daemon would have served.  Entering degraded mode warns once and
+        daemon would have served.  After a reshard the fallback composes
+        from the client's adopted membership (the snapshotted §6 cascade
+        chain and delivery trail), not the stale base spec, so it stays
+        exact across world changes.  Entering degraded mode warns once and
         counts ``degraded_mode`` on the client's metrics; every
         ``reattach_interval`` seconds a later epoch probes the daemon
         and re-attaches when it returns.  False restores strict
@@ -387,25 +394,13 @@ class HostDataLoader:
                 )
             return self._served_indices(epoch)
         F.fire("loader.regen")
-        if layers is None:
-            # the shared stream description (service/spec.py) — the same
-            # object an IndexServer of this config evaluates
-            return np.asarray(self.stream_spec.rank_indices(epoch, self.rank))
-        # §6 elastic remainder epochs stay local-only
-        if self.mixture is not None:
-            return self._mixture_indices(epoch, layers)
-        base = self._base_indices(epoch, layers)
-        if self.shard_sizes is None:
-            return base
-        if self.index_backend == "native":
-            from ..ops.native import expand_shard_indices_native as expand
-        else:
-            from .shard_mode import expand_shard_indices_np as expand
-        return expand(
-            base, self.shard_sizes, seed=self.seed, epoch=epoch,
-            within_shard_shuffle=self.within_shard_shuffle,
-            rounds=self.kwargs.get("rounds", core.DEFAULT_ROUNDS),
-        )
+        # the shared stream description (service/spec.py) — the same
+        # object an IndexServer of this config evaluates; §6 elastic
+        # remainder layers ride the same surface for every stream kind
+        return np.asarray(self.stream_spec.rank_indices(
+            epoch, self.rank,
+            layers=None if layers is None else list(layers),
+        ))
 
     def _served_indices(self, epoch: int) -> np.ndarray:
         """The service path with graceful degradation (docs/RESILIENCE.md).
@@ -449,75 +444,34 @@ class HostDataLoader:
     def _local_indices(self, epoch: int) -> np.ndarray:
         """Degraded-mode regen: evaluate the loader's own spec.  Safe to
         substitute for the served stream because the WELCOME handshake
-        already proved the daemon serves a spec with this fingerprint."""
-        wire = getattr(self.index_client, "spec_wire", None)
+        already proved the daemon serves a spec with this (world-stripped
+        — elastic membership legitimately drifts the world) fingerprint.
+
+        When the client has ridden through a reshard, the local stream is
+        composed from its adopted membership — the snapshotted §6 cascade
+        chain, orphan descriptors, and delivery trail — via
+        ``client.local_epoch_indices``; a stale base-spec regen would
+        serve the wrong partition of the remainder."""
+        client = self.index_client
+        wire = getattr(client, "spec_wire", None)
         if wire is not None:
             from ..service.spec import PartialShuffleSpec
 
-            served = PartialShuffleSpec.from_wire(wire).fingerprint()
-            ours = self.stream_spec.fingerprint()
+            served = PartialShuffleSpec.from_wire(wire).fingerprint(
+                include_world=False
+            )
+            ours = self.stream_spec.fingerprint(include_world=False)
             if served != ours:
                 raise RuntimeError(
                     f"cannot degrade to local regen: daemon spec "
                     f"fingerprint {served} != local {ours}"
                 )
         F.fire("loader.regen")
+        if client is not None and getattr(client, "generation", 0) > 0:
+            return np.asarray(
+                client.local_epoch_indices(self.stream_spec, epoch)
+            )
         return np.asarray(self.stream_spec.rank_indices(epoch, self.rank))
-
-    def _base_indices(self, epoch: int, layers) -> np.ndarray:
-        from ..ops.cpu import elastic_indices_np
-
-        return elastic_indices_np(
-            self.n, self.window, self.seed, epoch, self.rank, self.world,
-            list(layers),
-            shuffle=self.kwargs.get("shuffle", True),
-            drop_last=self.kwargs.get("drop_last", False),
-            order_windows=self.kwargs.get("order_windows", True),
-            partition=self.kwargs.get("partition", "strided"),
-            rounds=self.kwargs.get("rounds", core.DEFAULT_ROUNDS),
-        )
-
-    def _mixture_indices(self, epoch: int, layers) -> np.ndarray:
-        from ..ops import mixture as M
-
-        kw = dict(
-            epoch_samples=self.epoch_samples,
-            shuffle=self.kwargs.get("shuffle", True),
-            drop_last=self.kwargs.get("drop_last", False),
-            order_windows=self.kwargs.get("order_windows", True),
-            partition=self.kwargs.get("partition", "strided"),
-            rounds=self.kwargs.get("rounds", core.DEFAULT_ROUNDS),
-        )
-        if layers is not None:
-            if self.index_backend == "xla":
-                return np.asarray(M.mixture_elastic_indices_jax(
-                    self.mixture, self.seed, epoch, self.rank, self.world,
-                    list(layers), **kw,
-                ))
-            if self.index_backend == "native":
-                from ..ops.native import mixture_elastic_indices_native
-
-                return mixture_elastic_indices_native(
-                    self.mixture, self.seed, epoch, self.rank, self.world,
-                    list(layers), **kw,
-                )
-            return M.mixture_elastic_indices_np(
-                self.mixture, self.seed, epoch, self.rank, self.world,
-                list(layers), **kw,
-            )
-        if self.index_backend == "xla":
-            return np.asarray(M.mixture_epoch_indices_jax(
-                self.mixture, self.seed, epoch, self.rank, self.world, **kw,
-            ))
-        if self.index_backend == "native":
-            from ..ops.native import mixture_epoch_indices_native
-
-            return mixture_epoch_indices_native(
-                self.mixture, self.seed, epoch, self.rank, self.world, **kw,
-            )
-        return M.mixture_epoch_indices_np(
-            self.mixture, self.seed, epoch, self.rank, self.world, **kw,
-        )
 
     # -------------------------------------------------------------- gather
     def _gather(self, sl: np.ndarray) -> dict:

@@ -326,13 +326,21 @@ def _rowwise_swap(xp, x, m_col, key, pair_col, rounds: int):
 
 
 @functools.lru_cache(maxsize=None)
-def _bucket_scatter_jit(out_pad: int, m_b: int, big: bool):
+def _bucket_scatter_jit(out_len: int, m_b: int, big: bool):
     """The (cheap to compile) scatter stage: padded bucket values [R, m_b]
-    land in the output stream at per-row traced start positions, pad
+    land in ONE shared accumulator at per-row traced start positions, pad
     lanes OOB-dropped.  Split from the bijection program deliberately:
-    ``out_pad`` tracks the rank's per-epoch total and can flip across a
-    power-of-two boundary between epochs — that must invalidate only
-    this trivial program, never the 24-round-unrolled bucket bijections.
+    ``out_len`` tracks the rank's per-epoch total and changes between
+    epochs — that must invalidate only this trivial program, never the
+    24-round-unrolled bucket bijections.
+
+    The accumulator is donated: every (bucket, slab) program writes its
+    rows into the same exactly-``total``-long buffer in place.  The
+    first cut instead had each slab scatter into a fresh zeroed
+    next-pow2(total) buffer and summed them — O(slabs x pow2(total))
+    dense device adds and a 2x padded live buffer per slab, all of it
+    pure overhead since the slabs' target rows are disjoint by
+    construction (ADVICE r5 #4).
 
     The scatter itself is the point of the design: a host-built
     stream-order permutation array is O(total) bytes shipped host→device
@@ -342,20 +350,18 @@ def _bucket_scatter_jit(out_pad: int, m_b: int, big: bool):
     import jax
     import jax.numpy as jnp
 
-    dtype = jnp.int64 if big else jnp.int32
+    del big  # dtype rides in with the accumulator
 
-    @jax.jit
-    def f(vals, n_sub, starts_sub):
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def f(acc, vals, n_sub, starts_sub):
         c = jnp.arange(m_b, dtype=starts_sub.dtype)[None, :]
         valid = jnp.arange(m_b, dtype=jnp.uint32)[None, :] \
             < n_sub.astype(jnp.uint32)[:, None]
         tgt = jnp.where(
             valid, starts_sub[:, None] + c,
-            jnp.asarray(out_pad, dtype=starts_sub.dtype),  # OOB -> dropped
+            jnp.asarray(out_len, dtype=starts_sub.dtype),  # OOB -> dropped
         )
-        return jnp.zeros((out_pad,), dtype).at[tgt.reshape(-1)].set(
-            vals.reshape(-1), mode="drop"
-        )
+        return acc.at[tgt.reshape(-1)].set(vals.reshape(-1), mode="drop")
 
     return f
 
@@ -564,10 +570,12 @@ def _expand_bucketed_jax(sids, m_of, offsets, out_starts, total, full,
     to the group's power-of-two width and the row count padded to a
     power of two — so the compiled shapes are stable across epochs even
     though the rank's shard draw changes — each program scattering its
-    rows straight into the (pow2-padded) output stream at per-row start
-    positions.  Host→device traffic is O(rows), never O(total): the
-    first cut shipped an O(total) stream-order permutation and measured
-    50x the uniform-size cost on the bench rig's tunnel."""
+    rows straight into ONE donated, exactly-``total``-long output buffer
+    at per-row start positions (the slabs' target rows tile [0, total)
+    disjointly, so in-place scatters compose with no cross-slab adds).
+    Host→device traffic is O(rows), never O(total): the first cut
+    shipped an O(total) stream-order permutation and measured 50x the
+    uniform-size cost on the bench rig's tunnel."""
     import jax.numpy as jnp
 
     # a bounded window covering the shard is the same one-bijection
@@ -585,14 +593,16 @@ def _expand_bucketed_jax(sids, m_of, offsets, out_starts, total, full,
         groups.setdefault(
             (full_like, _next_pow2(int(m_of[i]))), []
         ).append(i)
-    out_pad = _next_pow2(max(int(total), 1))
-    acc = None
+    if not groups:
+        return jnp.empty(0, dtype=dtype)
+    out_len = int(total)
+    acc = jnp.zeros((out_len,), dtype)
     for full_like, m_b in sorted(groups):
         members = np.asarray(groups[(full_like, m_b)])
         f = _bucket_expand_jit(
             m_b, full_like, 0 if full_like else w_eff, rounds, big
         )
-        scat = _bucket_scatter_jit(out_pad, m_b, big)
+        scat = _bucket_scatter_jit(out_len, m_b, big)
         max_rows = _next_pow2(max(1, _DEVICE_SLAB_ELEMS // m_b))
         for i0 in range(0, len(members), max_rows):
             slab = members[i0:i0 + max_rows]
@@ -605,11 +615,9 @@ def _expand_bucketed_jax(sids, m_of, offsets, out_starts, total, full,
             off_in[:len(slab)] = offsets[sids[slab]]
             starts_in = np.zeros(rows, off_dtype)
             starts_in[:len(slab)] = out_starts[slab]
-            part = scat(f(sid_in, n_in, off_in, *traced), n_in, starts_in)
-            acc = part if acc is None else acc + part
-    if acc is None:
-        return jnp.empty(0, dtype=dtype)
-    return acc[:int(total)]
+            acc = scat(acc, f(sid_in, n_in, off_in, *traced), n_in,
+                       starts_in)
+    return acc
 
 
 def expand_shard_indices(

@@ -6,10 +6,13 @@ permutation; this subsystem turns that into infrastructure (docs/SERVICE.md):
 or shard-mode), generates each epoch once through the existing backends,
 and streams disjoint per-rank index ranges to N
 :class:`ServiceIndexClient` s over loopback TCP — with backpressure,
-rank leases, reconnect/resume, snapshots, and metrics.
+rank leases, reconnect/resume, snapshots, metrics, and elastic
+membership (mid-epoch resharding with preemption-aware drain,
+docs/RESILIENCE.md "Elastic membership").
 """
 
 from .client import (  # noqa: F401
+    ReshardInProgress,
     ServiceError,
     ServiceIndexClient,
     ServiceUnavailable,

@@ -17,6 +17,18 @@ Drop-in surfaces:
   ``start_seq`` / ``state_dict()``; wrap it in
   :class:`~..utils.stall_probe.StallProbe` to measure service-path
   starvation the same way the local loaders are measured.
+
+Elastic membership (docs/RESILIENCE.md "Elastic membership"): the client
+stamps every ``GET_BATCH`` with the server generation it believes in;
+when a reshard commits underneath it, the server's typed ``resharded``
+error carries the new membership (generation, world, §6 cascade layers,
+orphan descriptors) and the stream *rides through*: the generator adopts
+it, renegotiates a rank if its old one no longer exists, and continues
+yielding the post-reshard remainder — the consumer sees one contiguous,
+exactly-once stream across the world change.  ``leave(grace_ms)`` is the
+preemption-notice drain (hook it to SIGTERM); while a barrier drains,
+requests wait it out through the retry policy and surface a typed
+:class:`ReshardInProgress` only when the deadline is exhausted.
 """
 
 from __future__ import annotations
@@ -27,6 +39,7 @@ from typing import Iterator, Optional
 
 import numpy as np
 
+from .. import faults as F
 from ..utils.retry import RetryPolicy
 from . import protocol as P
 from .metrics import ServiceMetrics
@@ -34,8 +47,8 @@ from .metrics import ServiceMetrics
 #: ERROR codes that indicate a configuration/contract problem — retrying
 #: cannot fix them, so they raise immediately
 _FATAL_CODES = frozenset(
-    {"proto", "world", "spec", "batch", "bad_request", "unknown_type",
-     "protocol", "no_rank"}
+    {"proto", "protocol_version", "world", "spec", "batch", "bad_request",
+     "unknown_type", "protocol", "no_rank"}
 )
 
 #: consecutive checksum rejects on one seq before the client gives up on
@@ -44,11 +57,15 @@ _MAX_CHECKSUM_REJECTS = 4
 
 
 class ServiceError(RuntimeError):
-    """Server answered ERROR; ``code`` carries the protocol error code."""
+    """Server answered ERROR; ``code`` carries the protocol error code
+    and ``header`` the full reply header (membership fields ride there
+    on ``resharded`` errors)."""
 
-    def __init__(self, code: str, detail: str = "") -> None:
+    def __init__(self, code: str, detail: str = "",
+                 header: Optional[dict] = None) -> None:
         super().__init__(f"[{code}] {detail}" if detail else code)
         self.code = code
+        self.header = header if header is not None else {}
 
 
 class ServiceUnavailable(ServiceError):
@@ -56,6 +73,15 @@ class ServiceUnavailable(ServiceError):
 
     def __init__(self, detail: str) -> None:
         super().__init__("unavailable", detail)
+
+
+class ReshardInProgress(ServiceError):
+    """A reshard barrier kept the server draining past the operation's
+    retry deadline.  The stream is intact — retrying the same operation
+    after the barrier commits continues it exactly-once."""
+
+    def __init__(self, detail: str) -> None:
+        super().__init__("reshard", detail)
 
 
 def _parse_address(address):
@@ -131,6 +157,23 @@ class ServiceIndexClient:
         self.server_epoch: Optional[int] = None
         self._sock: Optional[socket.socket] = None
         self._cursor = {"epoch": None, "seq": 0}  # next undelivered batch
+        # -------- elastic membership (docs/RESILIENCE.md) --------
+        # The server's view of the world, adopted from WELCOME and from
+        # ``resharded`` errors.  ``layers`` is the §6 cascade (outermost
+        # first); ``orphans`` the descriptors rank 0 serves as a prefix.
+        self.generation = 0
+        self.world: Optional[int] = None if spec is None else int(spec.world)
+        self.layers: list = []
+        self.elastic_epoch: Optional[int] = None
+        self.orphans: list = []
+        #: memberships this client already streamed part of the current
+        #: epoch under: ``{"rank","world","layers","orphans","samples"}``
+        #: per generation ridden through — the degraded fallback replays
+        #: exactly these prefixes (``local_epoch_indices``).
+        self._trail: list = []
+        self._epoch_samples = 0          # delivered watermark, current gen
+        self._samples_epoch: Optional[int] = None
+        self._leaving = False            # set by leave(): boundary = eof
 
     # ----------------------------------------------------------- connection
     def _connect(self) -> None:
@@ -143,8 +186,10 @@ class ServiceIndexClient:
             "batch": self.batch,
         }
         if self.expected_spec is not None:
-            hello["world"] = self.expected_spec.world
-            hello["spec_fingerprint"] = self.expected_spec.fingerprint()
+            # world-stripped: under elastic membership the server's world
+            # drifts legitimately; only the stream-shaping config must match
+            hello["spec_fingerprint"] = \
+                self.expected_spec.fingerprint(include_world=False)
         try:
             P.send_msg(sock, P.MSG_HELLO, hello)
             msg, header, _ = P.recv_msg(sock)
@@ -154,7 +199,7 @@ class ServiceIndexClient:
         if msg == P.MSG_ERROR:
             sock.close()
             raise ServiceError(header.get("code", "error"),
-                               header.get("detail", ""))
+                               header.get("detail", ""), header)
         if msg != P.MSG_WELCOME:
             sock.close()
             raise P.ProtocolError(
@@ -163,7 +208,39 @@ class ServiceIndexClient:
         self.rank = int(header["rank"])
         self.spec_wire = header.get("spec")
         self.server_epoch = header.get("epoch")
+        self._adopt_membership(header)
         self._sock = sock
+
+    def _adopt_membership(self, header: dict) -> None:
+        """Take on the membership a WELCOME or ``resharded`` error carries.
+
+        When the generation advanced past ours and we had already
+        delivered part of the current epoch, the outgoing membership is
+        pushed onto the trail with its exact delivered watermark — the
+        degraded fallback later replays precisely those prefixes."""
+        if "generation" not in header:
+            return
+        gen = int(header["generation"])
+        if gen > self.generation:
+            if self.world is not None and self.rank is not None:
+                self._trail.append({
+                    "rank": self.rank, "world": self.world,
+                    "layers": [tuple(map(int, l)) for l in self.layers],
+                    "orphans": list(self.orphans),
+                    "samples": int(self._epoch_samples),
+                })
+            self._epoch_samples = 0
+            if self._samples_epoch is not None:
+                # only a client that was already streaming rode through;
+                # a fresh HELLO adopting a resharded server's membership
+                # didn't cross a world change
+                self.metrics.inc("reshards_ridden", self.rank)
+        self.generation = gen
+        self.world = int(header["world"])
+        self.layers = [tuple(map(int, l)) for l in header.get("layers", [])]
+        ee = header.get("elastic_epoch")
+        self.elastic_epoch = None if ee is None else int(ee)
+        self.orphans = list(header.get("orphans", []))
 
     def _ensure_connected(self) -> None:
         if self._sock is None:
@@ -281,9 +358,22 @@ class ServiceIndexClient:
                     # it never does within the deadline
                     self.close()
                     if not op.pause():
-                        raise ServiceError(code, rheader.get("detail", ""))
+                        raise ServiceError(code, rheader.get("detail", ""),
+                                           rheader)
                     continue
-                raise ServiceError(code, rheader.get("detail", ""))
+                if code == "reshard":
+                    # a barrier is freezing/draining: wait it out on this
+                    # side of the retry deadline — the post-commit replay
+                    # of the same request is exactly-once by construction
+                    self.metrics.inc("reshard_waits", self.rank)
+                    retry_s = float(rheader.get("retry_ms", 50)) / 1e3
+                    if not op.pause(min_delay=retry_s):
+                        raise ReshardInProgress(
+                            f"reshard barrier at {self.address} did not "
+                            "commit within the retry deadline"
+                        )
+                    continue
+                raise ServiceError(code, rheader.get("detail", ""), rheader)
             return reply, rheader, payload
 
     # ------------------------------------------------------------- batches
@@ -293,20 +383,73 @@ class ServiceIndexClient:
 
         Each ``GET_BATCH`` acks everything before it (the batches this
         generator already yielded), keeping the in-flight window at one —
-        comfortably inside any server's ``max_inflight``."""
+        comfortably inside any server's ``max_inflight``.
+
+        Rides through reshards: a ``resharded`` reply (or reconnect) makes
+        the generator adopt the new membership, renegotiate a rank if its
+        old one no longer exists, and continue with the post-reshard
+        remainder — one contiguous exactly-once stream across the world
+        change.  It ends early (without error) only when the rank *left*
+        (terminal drain eof) or the shrunken world has no free slot left
+        (``membership_lost`` in the metrics)."""
         epoch, seq = int(epoch), int(start_seq)
         self._cursor = {"epoch": epoch, "seq": seq}
+        if self._samples_epoch != epoch:
+            # new epoch: the trail describes the previous epoch's
+            # deliveries — start fresh
+            self._trail = []
+            self._epoch_samples = 0
+            self._samples_epoch = epoch
         rejects = 0
+        gen = self.generation
         while True:
-            reply, header, payload = self._rpc(P.MSG_GET_BATCH, {
-                "rank": self.rank, "epoch": epoch, "seq": seq,
-                "ack": seq - 1,
-            })
+            if self.generation != gen:
+                # a reconnect inside _rpc adopted a newer membership
+                # (WELCOME on our still-valid rank): continue from the
+                # head of the post-reshard remainder
+                gen, seq = self.generation, 0
+                self._cursor = {"epoch": epoch, "seq": seq}
+            try:
+                reply, header, payload = self._rpc(P.MSG_GET_BATCH, {
+                    "rank": self.rank, "epoch": epoch, "seq": seq,
+                    "ack": seq - 1, "gen": gen,
+                })
+            except ServiceError as exc:
+                if exc.code == "resharded":
+                    if self._leaving:
+                        # we asked to LEAVE and the barrier committed:
+                        # our pre-barrier allocation is fully served
+                        # (the commit required our drain), so this is
+                        # the stream's end, not a membership to ride
+                        return
+                    # the world changed underneath us: adopt the carried
+                    # membership and continue the stream under it
+                    self._adopt_membership(exc.header)
+                    if not (self.rank is not None and self.world is not None
+                            and self.rank < self.world):
+                        # our rank no longer exists — auto-claim a freed
+                        # slot (typically the leaver's) on reconnect
+                        self.close()
+                        self.rank = None
+                    gen, seq = self.generation, 0
+                    self._cursor = {"epoch": epoch, "seq": seq}
+                    continue
+                if exc.code == "no_rank" and self.rank is None:
+                    # the world shrank past us and every surviving slot is
+                    # claimed: our share of the epoch belongs to others now
+                    self.metrics.inc("membership_lost")
+                    return
+                raise
             if reply != P.MSG_BATCH:
                 raise P.ProtocolError(
                     f"expected BATCH, got {P.msg_name(reply)}"
                 )
             if header.get("eof"):
+                # a terminal drain eof additionally carries left=True; in
+                # both cases the stream for this rank is complete
+                if header.get("end") is not None:
+                    self._epoch_samples = max(self._epoch_samples,
+                                              int(header["end"]))
                 return
             try:
                 arr = P.decode_indices(header, payload)
@@ -327,6 +470,11 @@ class ServiceIndexClient:
             # resumes at the next one (exactly-once, not at-least-once)
             seq += 1
             self._cursor = {"epoch": epoch, "seq": seq}
+            if header.get("end") is not None:
+                # exact delivered watermark in the current generation's
+                # stream — what the trail records at the next adoption
+                self._epoch_samples = max(self._epoch_samples,
+                                          int(header["end"]))
             yield arr
 
     def epoch_indices(self, epoch: int) -> np.ndarray:
@@ -354,6 +502,97 @@ class ServiceIndexClient:
     def server_metrics(self) -> dict:
         _, header, _ = self._rpc(P.MSG_METRICS, {})
         return header["report"]
+
+    # ------------------------------------------------------------- elastic
+    def leave(self, grace_ms: Optional[int] = None) -> dict:
+        """Preemption-notice drain (hook this to SIGTERM): ask the server
+        to reshard the world down by one and drain this rank out.
+
+        Returns the server's OK header; when its ``reshard`` field is
+        True it carries ``target_world`` and this rank's
+        ``target_samples`` drain watermark — keep consuming
+        ``epoch_batches`` until the terminal eof so the barrier can
+        commit.  ``grace_ms`` bounds how long the server waits for that
+        drain before declaring this rank dead and orphaning the
+        un-served remainder (``None`` = wait indefinitely)."""
+        F.fire("client.leave")
+        header = {"rank": self.rank}
+        if grace_ms is not None:
+            header["grace_ms"] = int(grace_ms)
+        _, rheader, _ = self._rpc(P.MSG_LEAVE, header)
+        self.metrics.inc("leaves", self.rank)
+        if rheader.get("reshard"):
+            # commit requires our drain, so by the time the generation
+            # moves on we have served the full pre-barrier allocation —
+            # the boundary IS our terminal eof, whether it arrives as the
+            # drain eof or as a post-commit ``resharded`` reply
+            self._leaving = True
+        return rheader
+
+    def reshard(self, new_world: int) -> dict:
+        """Explicit mid-epoch world change: freeze a barrier at every
+        rank's consumption watermark and repartition the remainder over
+        ``new_world`` ranks (SPEC.md §6 cascade).  Returns the server's
+        OK header (``committed`` is True when the barrier already
+        drained — e.g. all ranks idle — and the new generation is live)."""
+        _, rheader, _ = self._rpc(P.MSG_RESHARD, {"world": int(new_world)})
+        return rheader
+
+    def local_epoch_indices(self, spec, epoch: int) -> np.ndarray:
+        """Compose this client's epoch stream LOCALLY from its adopted
+        membership — the degraded-mode fallback's source of truth.
+
+        For a non-elastic epoch this is simply the rank's stream under
+        the current membership.  For the elastic epoch it is the exact
+        trail of memberships this client delivered under: each
+        ridden-through generation contributes the prefix it actually
+        served (its recorded watermark), and the current membership
+        contributes its full remainder stream — together bit-identical
+        to what the service would have gone on to serve.  ``spec`` is
+        the stream-shaping spec (any world; each membership entry
+        re-bases it via ``with_world``)."""
+        epoch = int(epoch)
+
+        def stream(rank, world, layers, orphans):
+            if rank is None or world is None or rank >= int(world):
+                return np.empty(0, dtype=np.int64)
+            s = spec.with_world(int(world))
+            arr = np.asarray(s.rank_indices(
+                epoch, int(rank),
+                layers=[tuple(map(int, l)) for l in layers] or None,
+            ))
+            if rank == 0 and orphans:
+                pre = [self._orphan_slice(spec, o) for o in orphans
+                       if int(o["epoch"]) == epoch]
+                if pre:
+                    arr = np.concatenate(pre + [arr])
+            return arr
+
+        if self.elastic_epoch != epoch:
+            # no cascade applies to this epoch: one plain stream (the
+            # orphan filter drops other epochs' descriptors)
+            return stream(self.rank, self.world, [], self.orphans)
+        parts = []
+        if self._samples_epoch == epoch:
+            for m in self._trail:
+                parts.append(stream(m["rank"], m["world"], m["layers"],
+                                    m["orphans"])[: int(m["samples"])])
+        parts.append(stream(self.rank, self.world, self.layers,
+                            self.orphans))
+        parts = [p for p in parts if len(p)]
+        if not parts:
+            return np.empty(0, dtype=np.int64)
+        return np.concatenate(parts)
+
+    @staticmethod
+    def _orphan_slice(spec, o: dict) -> np.ndarray:
+        """Materialise one orphan descriptor against ``spec`` — the same
+        law the server applies when serving rank 0's prefix."""
+        layers = [tuple(map(int, l)) for l in o.get("layers", [])] or None
+        s = spec.with_world(int(o["world"]))
+        arr = np.asarray(s.rank_indices(int(o["epoch"]), int(o["rank"]),
+                                        layers=layers))
+        return arr[int(o["lo"]):int(o["hi"])]
 
     # ---------------------------------------------------------- checkpoint
     def state_dict(self) -> dict:

@@ -16,6 +16,18 @@ report produced one way.  Counter vocabulary (the ISSUE's metric set):
 * ``throttled``        — GET_BATCHs refused by backpressure
 * ``epoch_regen_ms``   — timer: per-(epoch, rank) index generation
 
+Elastic membership (docs/RESILIENCE.md "Elastic membership"):
+
+* ``leaves``           — LEAVE requests accepted (preemption drains)
+* ``reshard_triggers`` — barriers frozen (LEAVE, RESHARD RPC, eviction)
+* ``reshards``         — barriers committed (generation bumps)
+* ``orphaned``         — samples converted to orphan descriptors at a
+                         commit (dead ranks' un-drained allocations)
+
+Client-side additions with the same vocabulary: ``reshards_ridden``
+(memberships adopted mid-stream), ``reshard_waits`` (requests paused on
+a draining barrier), ``membership_lost`` (rejoin found no free rank).
+
 Per-client copies of the counters live under ``clients[rank]``; the
 registry holds the totals.  The epoch regen timer is the same
 :class:`RegenTimer` every sampler uses, so "epoch regen ms" means the
@@ -31,7 +43,7 @@ from ..utils.metrics import MetricsRegistry
 #: counter names with a per-client breakdown
 _PER_CLIENT = (
     "batches_served", "resends", "reconnects", "heartbeat_gaps", "evictions",
-    "throttled",
+    "throttled", "leaves",
 )
 
 

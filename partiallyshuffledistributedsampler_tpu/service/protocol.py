@@ -16,11 +16,15 @@ BATCH headers additionally carry ``crc32`` over the payload; a receiver
 that sees a mismatch raises :class:`ChecksumError` and (being idempotent)
 simply re-requests the same seq.
 
-Versioning: ``HELLO`` carries ``proto=PROTOCOL_VERSION`` and the server
-refuses mismatches up front, so a framing change bumps the constant and
-old clients fail at the handshake instead of mid-epoch.  Message types
-are stable small ints — new types may be added within a version; unknown
-types draw an ``ERROR`` reply, not a closed connection.
+Versioning: ``HELLO`` carries ``proto=PROTOCOL_VERSION``; the peers
+negotiate it explicitly — a mismatch draws a typed
+``ERROR(code='protocol_version')`` carrying both version ints, so an old
+client fails at the handshake with an actionable error instead of
+undefined frame decoding mid-epoch.  Message types are stable small ints
+— new types may be added within a version; unknown types draw an
+``ERROR`` reply, not a closed connection.  Version 2 added the elastic
+membership messages (``LEAVE``/``RESHARD``), generation-stamped
+``GET_BATCH``, and the v2 snapshot schema (docs/SERVICE.md).
 
 Request → reply pairs (client sends left, server answers right):
 
@@ -30,6 +34,14 @@ Request → reply pairs (client sends left, server answers right):
     SNAPSHOT   → SNAPSHOT_STATE      server state (restart/restore dict)
     HEARTBEAT  → OK                  keep the rank lease alive
     METRICS    → METRICS_REPORT      the daemon's counters/timers
+    LEAVE      → OK | ERROR          preemption-notice drain: trigger a
+                                     reshard to world-1 and drain out
+    RESHARD    → OK | ERROR          explicit mid-epoch world change
+
+Elastic error codes (docs/RESILIENCE.md "Elastic membership"):
+``reshard`` (barrier in progress — retry shortly), ``resharded`` (the
+request named a stale generation; the header carries the new
+``generation``/``world``/``layers`` membership to adopt).
 """
 
 from __future__ import annotations
@@ -43,8 +55,9 @@ import numpy as np
 
 from .. import faults as F
 
-#: bump on any framing/semantics change; HELLO negotiates it
-PROTOCOL_VERSION = 1
+#: bump on any framing/semantics change; HELLO negotiates it.
+#: v2: LEAVE/RESHARD messages, generation-stamped GET_BATCH, snapshot v2.
+PROTOCOL_VERSION = 2
 
 #: frames above this are a protocol violation (a corrupt length prefix
 #: must not make the reader try to allocate gigabytes)
@@ -62,6 +75,8 @@ MSG_OK = 9
 MSG_ERROR = 10
 MSG_METRICS = 11
 MSG_METRICS_REPORT = 12
+MSG_LEAVE = 13
+MSG_RESHARD = 14
 
 _NAMES = {
     v: k[len("MSG_"):] for k, v in list(globals().items())

@@ -30,6 +30,23 @@ framing).  Design points, in the order they matter:
   resumes from it.  Correctness does not depend on the snapshot (streams
   are pure), it restores the *operational* state: the served epoch and
   where each client was.
+* **Elastic membership** (docs/RESILIENCE.md "Elastic membership").  A
+  client ``LEAVE`` (preemption-notice drain), a rank staying vacant past
+  ``membership_timeout``, or an explicit ``RESHARD(new_world)`` RPC
+  freezes a reshard barrier: the per-rank consumption watermarks already
+  tracked by the batch cursors are converted to whole consumed base
+  units (samples, or SHARDS for shard mode), the barrier is their max
+  ``C``, and every live rank drains — keeps being served its old
+  partition, clamped to the barrier's per-rank sample target.  When all
+  participants have drained (dead ones become *orphan* descriptors,
+  served later as a prefix of rank 0's stream), the server appends the
+  ``(old_world, C)`` cascade layer from SPEC.md §6, re-partitions the
+  remainder at the new world via ``ops.core``'s reshard chain, and bumps
+  its ``generation``; requests stamped with a stale generation draw
+  ``ERROR(code='resharded')`` carrying the new membership, so surviving
+  clients pick up the remainder stream exactly-once — no index served
+  twice or dropped.  The v2 snapshot persists the cascade + watermarks,
+  so a killed-and-restarted daemon resumes mid-cascade.
 """
 
 from __future__ import annotations
@@ -41,6 +58,8 @@ import time
 import warnings
 from collections import OrderedDict
 from typing import Optional
+
+import numpy as np
 
 from .. import faults as F
 from ..utils.checkpoint import load_sampler_state, save_sampler_state
@@ -61,7 +80,13 @@ class IndexServer:
 
     One thread accepts, one thread per connection serves; all daemonic.
     ``max_inflight`` bounds un-acked batches per rank; ``heartbeat_timeout``
-    bounds how long a silent connection holds its rank lease."""
+    bounds how long a silent connection holds its rank lease.
+
+    ``membership_timeout`` (seconds, default None = disabled) arms the
+    eviction reshard: a rank whose lease stays vacant that long is
+    treated as permanently preempted — the server triggers a reshard to
+    ``world - vacancies`` and converts the rank's un-drained allocation
+    to orphan descriptors instead of stalling the whole pod on it."""
 
     def __init__(
         self,
@@ -71,6 +96,7 @@ class IndexServer:
         *,
         max_inflight: int = 8,
         heartbeat_timeout: float = 30.0,
+        membership_timeout: Optional[float] = None,
         snapshot_path: Optional[str] = None,
         snapshot_interval: int = 64,
         max_cached_arrays: Optional[int] = None,
@@ -83,6 +109,9 @@ class IndexServer:
         self.host, self.port = host, int(port)
         self.max_inflight = int(max_inflight)
         self.heartbeat_timeout = float(heartbeat_timeout)
+        self.membership_timeout = (
+            None if membership_timeout is None else float(membership_timeout)
+        )
         self.snapshot_path = snapshot_path
         self.snapshot_interval = max(1, int(snapshot_interval))
         #: lease time source — injectable so eviction timing is testable
@@ -101,9 +130,26 @@ class IndexServer:
         self._cache: OrderedDict[tuple, object] = OrderedDict()
         #: rank -> {"owner": conn_id|None, "last_seen": t, "batch": int}
         self._leases: dict[int, dict] = {}
-        #: rank -> {"epoch": e, "acked": int, "hi": int} (hi = highest
-        #: seq ever served; a request at or below it is a resend)
+        #: rank -> {"epoch": e, "acked": int, "hi": int, "samples": int}
+        #: (hi = highest seq ever served, a request at or below it is a
+        #: resend; samples = served sample high-water, the consumption
+        #: watermark an elastic barrier cuts on)
         self._cursors: dict[int, dict] = {}
+        # ---- elastic membership state (all under self._lock) ----
+        #: bumped at every reshard commit; GET_BATCH stamps it
+        self.generation = 0
+        #: SPEC.md §6 cascade [(world, consumed_units), ...] outermost
+        #: first, applying to epoch ``elastic_epoch`` only
+        self.layers: list[tuple[int, int]] = []
+        self.elastic_epoch: Optional[int] = None
+        #: un-drained allocations of dead ranks, served as a prefix of
+        #: rank 0's stream: JSON-safe {epoch, rank, world, layers, lo, hi}
+        #: descriptors over the PURE partition stream of their generation
+        self._orphans: list[dict] = []
+        #: in-flight reshard (phase 'freeze' → 'drain'), None otherwise
+        self._reshard: Optional[dict] = None
+        #: rank -> clock time its lease went vacant (membership_timeout)
+        self._vacated: dict[int, float] = {}
         self._listener: Optional[socket.socket] = None
         self._threads: list[threading.Thread] = []
         self._conn_socks: dict[int, socket.socket] = {}
@@ -197,16 +243,40 @@ class IndexServer:
 
     # ------------------------------------------------------------- snapshot
     def _state_dict(self) -> dict:
+        """Snapshot format 2 (docs/SERVICE.md): v1's kind/proto/spec/
+        epoch/cursors plus the elastic membership — generation, cascade
+        layers, orphan descriptors, per-cursor sample watermarks, and an
+        in-flight drain (so a killed daemon resumes mid-cascade).  Leave
+        grace deadlines are monotonic-clock-relative and do NOT persist
+        (a restarted drain falls back to ``membership_timeout``)."""
         with self._lock:
-            return {
+            state = {
                 "kind": SNAPSHOT_KIND,
+                "format": 2,
                 "proto": P.PROTOCOL_VERSION,
                 "spec": self.spec.to_wire(),
                 "epoch": self.epoch,
+                "generation": self.generation,
+                "layers": [[int(w), int(c)] for w, c in self.layers],
+                "elastic_epoch": self.elastic_epoch,
+                "orphans": [dict(o) for o in self._orphans],
                 "cursors": {
                     str(r): dict(c) for r, c in self._cursors.items()
                 },
             }
+            rs = self._reshard
+            if rs is not None and rs.get("phase") == "drain":
+                state["reshard"] = {
+                    "target_world": int(rs["target_world"]),
+                    "epoch": int(rs["epoch"]),
+                    "barrier_units": int(rs["barrier_units"]),
+                    "targets": {str(r): int(t)
+                                for r, t in rs["targets"].items()},
+                    "drained": sorted(rs["drained"]),
+                    "dead": sorted(rs["dead"]),
+                    "leaving": sorted(rs["leaving"]),
+                }
+            return state
 
     def _restore(self, state: dict) -> None:
         if state.get("kind") != SNAPSHOT_KIND:
@@ -214,21 +284,54 @@ class IndexServer:
                 f"snapshot kind {state.get('kind')!r} is not a "
                 f"{SNAPSHOT_KIND!r} snapshot"
             )
+        fmt = int(state.get("format", 1))
         theirs = PartialShuffleSpec.from_wire(state["spec"],
                                               backend=self.spec.backend)
-        if theirs.fingerprint() != self.spec.fingerprint():
+        # world is authoritative SERVER state once resharding exists, so
+        # the identity check strips it; a v2 snapshot's world is adopted
+        ours = self.spec.fingerprint(include_world=False)
+        if theirs.fingerprint(include_world=False) != ours:
             raise ValueError(
                 "snapshot was written by a server with a different stream "
                 f"spec: {theirs.fingerprint()} != {self.spec.fingerprint()}; "
                 "serving it would hand clients a different permutation"
             )
+        if fmt < 2 and theirs.world != self.spec.world:
+            raise ValueError(
+                f"pre-elastic (format 1) snapshot has world {theirs.world}; "
+                f"this server was constructed with world {self.spec.world}"
+            )
         with self._lock:
             self.epoch = int(state.get("epoch", 0))
             self._cursors = {
                 int(r): {"epoch": int(c["epoch"]), "acked": int(c["acked"]),
-                         "hi": int(c["hi"])}
+                         "hi": int(c["hi"]),
+                         "samples": int(c.get("samples", 0))}
                 for r, c in state.get("cursors", {}).items()
             }
+            if fmt < 2:
+                return
+            self.generation = int(state.get("generation", 0))
+            self.layers = [(int(w), int(c))
+                           for w, c in state.get("layers") or []]
+            ee = state.get("elastic_epoch")
+            self.elastic_epoch = None if ee is None else int(ee)
+            self._orphans = [dict(o) for o in state.get("orphans") or []]
+            if theirs.world != self.spec.world:
+                self.spec = self.spec.with_world(theirs.world)
+            rs = state.get("reshard")
+            if rs is not None:
+                self._reshard = {
+                    "phase": "drain",
+                    "target_world": int(rs["target_world"]),
+                    "epoch": int(rs["epoch"]),
+                    "barrier_units": int(rs["barrier_units"]),
+                    "targets": {int(r): int(t)
+                                for r, t in rs["targets"].items()},
+                    "drained": {int(r) for r in rs.get("drained", [])},
+                    "dead": {int(r) for r in rs.get("dead", [])},
+                    "leaving": {int(r): None for r in rs.get("leaving", [])},
+                }
 
     def _write_snapshot(self, force: bool = False) -> None:
         if not self.snapshot_path:
@@ -257,15 +360,48 @@ class IndexServer:
                 )
 
     # ------------------------------------------------------------ the cache
+    def _gen_layers_locked(self, epoch: int):
+        """The cascade that applies to ``epoch`` (None for every other
+        epoch — layers describe ONE epoch's partial consumption)."""
+        if self.layers and epoch == self.elastic_epoch:
+            return list(self.layers)
+        return None
+
+    def _orphan_len_locked(self, epoch: int) -> int:
+        return sum(int(o["hi"]) - int(o["lo"]) for o in self._orphans
+                   if int(o["epoch"]) == epoch)
+
+    def _orphan_slice(self, spec: PartialShuffleSpec, o: dict):
+        """Regenerate one orphan descriptor: the un-drained slice of a
+        dead rank's stream in the generation it was defined."""
+        s = spec.with_world(int(o["world"]))
+        layers = [(int(w), int(c)) for w, c in o.get("layers") or []]
+        arr = s.rank_indices(int(o["epoch"]), int(o["rank"]),
+                             layers=layers or None)
+        return np.asarray(arr)[int(o["lo"]):int(o["hi"])]
+
     def _rank_array(self, epoch: int, rank: int):
-        key = (int(epoch), int(rank))
+        with self._lock:
+            spec = self.spec
+            gen = self.generation
+            layers = self._gen_layers_locked(int(epoch))
+            orphans = ([dict(o) for o in self._orphans
+                        if int(o["epoch"]) == int(epoch)]
+                       if rank == 0 else [])
+        key = (gen, int(epoch), int(rank))
         with self._gen_lock:
             arr = self._cache.get(key)
             if arr is not None:
                 self._cache.move_to_end(key)
                 return arr
             with self.metrics.regen_timer.measure():
-                arr = self.spec.rank_indices(epoch, rank)
+                arr = np.asarray(spec.rank_indices(epoch, rank,
+                                                   layers=layers))
+                if orphans:
+                    # dead ranks' un-drained allocations ride as a prefix
+                    # of rank 0's stream — every index still served once
+                    parts = [self._orphan_slice(spec, o) for o in orphans]
+                    arr = np.concatenate(parts + [arr])
             arr.setflags(write=False)
             self._cache[key] = arr
             while len(self._cache) > self._max_cached:
@@ -313,6 +449,7 @@ class IndexServer:
                     continue
                 if now - lease["last_seen"] > self.heartbeat_timeout:
                     lease["owner"] = None
+                    self._vacated.setdefault(rank, now)
                     self.metrics.inc("evictions", rank)
                     sock = self._conn_socks.get(owner)
                     if sock is not None:
@@ -321,6 +458,62 @@ class IndexServer:
             try:
                 sock.close()
             except OSError:
+                pass
+        self._sweep_membership(now)
+
+    def _sweep_membership(self, now: float) -> None:
+        """Elastic liveness, on the accept-loop tick: convert dead drain
+        participants (grace expired, or vacant past ``membership_timeout``)
+        to orphans, commit a fully-drained barrier whose committing request
+        died mid-flight, and trigger the eviction reshard for ranks vacant
+        past ``membership_timeout`` — so a drain can never deadlock on a
+        preempted host and a permanently-lost rank shrinks the world."""
+        trigger = None
+        committed = False
+        with self._lock:
+            rs = self._reshard
+            if rs is not None and rs.get("phase") == "drain":
+                for r in rs["targets"]:
+                    if r in rs["drained"] or r in rs["dead"]:
+                        continue
+                    deadline = rs["leaving"].get(r)
+                    if deadline is not None and now >= deadline:
+                        rs["dead"].add(r)
+                        continue
+                    lease = self._leases.get(r)
+                    vacant = lease is None or lease.get("owner") is None
+                    if (vacant and self.membership_timeout is not None
+                            and r in self._vacated
+                            and now - self._vacated[r]
+                            > self.membership_timeout):
+                        rs["dead"].add(r)
+                try:
+                    committed = self._commit_reshard_locked()
+                except F.InjectedThreadDeath:
+                    raise
+                except Exception:
+                    pass  # injected commit fault: state intact, retried
+            elif (rs is None and self.membership_timeout is not None
+                    and self.spec.world > 1 and not self._draining.is_set()):
+                gone = {
+                    r for r, t0 in self._vacated.items()
+                    if r < self.spec.world
+                    and now - t0 > self.membership_timeout
+                    and (self._leases.get(r) is None
+                         or self._leases[r].get("owner") is None)
+                }
+                if gone:
+                    trigger = (max(1, self.spec.world - len(gone)), gone)
+        if committed:
+            self._write_snapshot(force=True)
+        if trigger is not None:
+            try:
+                self._trigger_reshard(trigger[0], dead=trigger[1])
+            except F.InjectedThreadDeath:
+                raise
+            except Exception:
+                # injected trigger fault: membership unchanged; the sweep
+                # re-arms on its next tick
                 pass
 
     # ------------------------------------------------------- per-connection
@@ -358,9 +551,10 @@ class IndexServer:
         client's replacement must not wait out the heartbeat timeout."""
         with self._lock:
             self._conn_socks.pop(conn_id, None)
-            for lease in self._leases.values():
+            for rank, lease in self._leases.items():
                 if lease.get("owner") == conn_id:
                     lease["owner"] = None
+                    self._vacated.setdefault(rank, self._clock())
 
     def _touch(self, rank: int, lease: dict) -> None:
         now = self._clock()
@@ -405,18 +599,305 @@ class IndexServer:
         elif msg == P.MSG_METRICS:
             P.send_msg(sock, P.MSG_METRICS_REPORT,
                        {"report": self.metrics.report()})
+        elif msg == P.MSG_LEAVE:
+            self._on_leave(sock, conn_id, header)
+        elif msg == P.MSG_RESHARD:
+            self._on_reshard(sock, conn_id, header)
         else:
             P.send_msg(sock, P.MSG_ERROR, {
                 "code": "unknown_type",
                 "detail": f"message type {P.msg_name(msg)} not served",
             })
 
+    # ------------------------------------------------- elastic membership
+    def _membership_locked(self) -> dict:
+        """The fields a client needs to adopt the current membership —
+        rides in WELCOME and in every ``resharded`` error."""
+        return {
+            "generation": self.generation,
+            "world": self.spec.world,
+            "epoch": self.epoch,
+            "layers": [[int(w), int(c)] for w, c in self.layers],
+            "elastic_epoch": self.elastic_epoch,
+            "orphans": [dict(o) for o in self._orphans],
+        }
+
+    def _resharded_err_locked(self, detail: str) -> dict:
+        return {"code": "resharded", "detail": detail,
+                **self._membership_locked()}
+
+    def _trigger_reshard(self, target_world: int, *, leaving=None,
+                         dead=None) -> bool:
+        """Freeze a reshard barrier and enter the drain phase.
+
+        The barrier ``C`` is the max over all ranks' consumption
+        watermarks converted to whole base units (samples, or SHARDS for
+        shard mode — a barrier must cut on whole shards so the remainder
+        expansion is exactly the expansion of the remainder shard IDs).
+        Ranks behind ``C`` keep being served their old partition, clamped
+        to their per-rank sample target; ranks at it wait out the commit.
+        Returns False when another reshard is already in flight."""
+        F.fire("server.reshard")
+        target_world = int(target_world)
+        if target_world < 1:
+            raise ValueError(f"target_world must be >= 1, got {target_world}")
+        with self._lock:
+            if self._reshard is not None or self._draining.is_set():
+                return False
+            world = self.spec.world
+            epochs = [c["epoch"] for c in self._cursors.values()]
+            # barrier at the epoch consumption is actually happening on
+            # (ranks advance epochs together — docs/RESILIENCE.md)
+            epoch = max(epochs) if epochs else self.epoch
+            self._reshard = {"phase": "freeze",
+                             "target_world": target_world, "epoch": epoch}
+            layers = self._gen_layers_locked(epoch)
+            orphan_len = self._orphan_len_locked(epoch)
+            samples = {
+                r: (int(self._cursors[r].get("samples", 0))
+                    if r in self._cursors
+                    and self._cursors[r]["epoch"] == epoch else 0)
+                for r in range(world)
+            }
+        try:
+            # unit structure may regenerate shard draws — outside the lock
+            # (the freeze phase pauses serving, so watermarks cannot move)
+            shard = self.spec.mode == "shard"
+            cums = {}
+            if shard:
+                for r in range(world):
+                    sizes = np.asarray(self.spec.rank_unit_sizes(
+                        epoch, r, layers=layers), dtype=np.int64)
+                    cums[r] = np.concatenate(([0], np.cumsum(sizes)))
+            units = {}
+            for r in range(world):
+                s = max(0, samples[r] - (orphan_len if r == 0 else 0))
+                # whole units STARTED: sample s-1 lives in unit u-1
+                units[r] = (int(np.searchsorted(cums[r], s, side="left"))
+                            if shard else s)
+            barrier = max(units.values(), default=0)
+        except BaseException:
+            with self._lock:
+                self._reshard = None
+            raise
+        with self._lock:
+            rs = self._reshard
+            targets = {}
+            for r in range(world):
+                t = int(cums[r][barrier]) if shard else int(barrier)
+                if r == 0:
+                    t += orphan_len
+                targets[r] = t
+            rs.update(
+                phase="drain",
+                barrier_units=int(barrier),
+                targets=targets,
+                drained={r for r in range(world)
+                         if r not in set(dead or ()) and
+                         samples[r] >= targets[r]},
+                leaving=dict(leaving or {}),
+                dead=set(dead or ()),
+            )
+            self.metrics.inc("reshard_triggers")
+            try:
+                self._commit_reshard_locked()
+            except F.InjectedThreadDeath:
+                raise
+            except Exception:
+                pass  # injected commit fault: drain state intact, retried
+        self._write_snapshot(force=True)
+        return True
+
+    def _clip_orphans_locked(self, rank: int, lo: int, hi: int, world: int,
+                             layers, epoch: int) -> list[dict]:
+        """Descriptors for a dead rank's un-served span ``[lo, hi)`` of its
+        current-generation stream.  Rank 0's stream is composite (orphan
+        prefix + partition), so the span decomposes into clips of the old
+        descriptors plus a partition descriptor — each over a PURE stream
+        of some earlier generation, hence regenerable forever."""
+        out: list[dict] = []
+        off = 0
+        if rank == 0:
+            for o in self._orphans:
+                if int(o["epoch"]) != epoch:
+                    continue
+                ln = int(o["hi"]) - int(o["lo"])
+                a, b = max(lo, off), min(hi, off + ln)
+                if a < b:
+                    out.append({**o, "lo": int(o["lo"]) + a - off,
+                                "hi": int(o["lo"]) + b - off})
+                off += ln
+        plo, phi = max(lo - off, 0), hi - off
+        if phi > plo:
+            out.append({
+                "epoch": int(epoch), "rank": int(rank), "world": int(world),
+                "layers": [[int(w), int(c)] for w, c in layers or []],
+                "lo": int(plo), "hi": int(phi),
+            })
+        return out
+
+    def _commit_reshard_locked(self) -> bool:
+        """Commit a fully-drained barrier: append the §6 cascade layer,
+        re-partition at the target world, bump the generation.  Idempotent
+        and callable from any drain participant's request or the sweep —
+        whichever observes the last drain wins.  Under ``self._lock``."""
+        rs = self._reshard
+        if rs is None or rs.get("phase") != "drain":
+            return False
+        for r in rs["targets"]:
+            if r not in rs["drained"] and r not in rs["dead"]:
+                return False
+        F.fire("server.reshard")  # before any mutation: a fault here
+        # leaves the drain intact for the sweep to re-commit
+        epoch = int(rs["epoch"])
+        old_world = self.spec.world
+        old_layers = self._gen_layers_locked(epoch) or []
+        new_orphans: list[dict] = []
+        for r in sorted(rs["dead"]):
+            t = int(rs["targets"][r])
+            cur = self._cursors.get(r)
+            s = (int(cur.get("samples", 0))
+                 if cur is not None and cur["epoch"] == epoch else 0)
+            s = min(s, t)
+            if s < t:
+                new_orphans.extend(self._clip_orphans_locked(
+                    r, s, t, old_world, old_layers, epoch))
+        self.layers = [(int(w), int(c)) for w, c in old_layers]
+        self.layers.append((old_world, int(rs["barrier_units"])))
+        self.elastic_epoch = epoch
+        self.spec = self.spec.with_world(int(rs["target_world"]))
+        self.generation += 1
+        self._orphans = new_orphans
+        self._cursors = {}
+        self._vacated = {}
+        now = self._clock()
+        for rank in list(self._leases):
+            if rank >= self.spec.world:
+                self._leases.pop(rank)
+            elif rank in rs["leaving"] or rank in rs["dead"]:
+                # the departed rank's slot in the NEW world must be
+                # claimable (the displaced top rank rejoins into it)
+                if self._leases[rank].get("owner") is not None:
+                    self._leases[rank]["owner"] = None
+                self._vacated[rank] = now
+        self._reshard = None
+        if new_orphans:
+            self.metrics.inc("orphaned", value=sum(
+                int(o["hi"]) - int(o["lo"]) for o in new_orphans))
+        self.metrics.inc("reshards")
+        return True
+
+    def _on_leave(self, sock, conn_id, header) -> None:
+        """Preemption-notice drain: the rank keeps its lease, drains its
+        pre-barrier allocation, then its stream ends (a terminal EOF) and
+        the world shrinks by one.  ``grace_ms`` bounds the drain — past
+        it the rank is declared dead and its remainder orphaned."""
+        try:
+            rank = int(header["rank"])
+        except (KeyError, TypeError, ValueError):
+            P.send_msg(sock, P.MSG_ERROR,
+                       {"code": "bad_request",
+                        "detail": "LEAVE needs an int rank"})
+            return
+        grace_ms = header.get("grace_ms")
+        deadline = (None if grace_ms is None
+                    else self._clock() + float(grace_ms) / 1e3)
+        with self._lock:
+            lease = self._leases.get(rank)
+            if lease is None or lease.get("owner") != conn_id:
+                P.send_msg(sock, P.MSG_ERROR, {
+                    "code": "not_owner",
+                    "detail": f"rank {rank} is not leased to this "
+                              "connection; HELLO first",
+                })
+                return
+            self._touch(rank, lease)
+            self.metrics.inc("leaves", rank)
+            world = self.spec.world
+            rs = self._reshard
+            if rs is not None:
+                if rs.get("phase") != "drain":
+                    P.send_msg(sock, P.MSG_ERROR, {
+                        "code": "reshard", "retry_ms": 20,
+                        "detail": "a reshard barrier is freezing; retry",
+                    })
+                    return
+                # join the in-flight barrier instead of compounding a
+                # second one: same targets, one fewer post-reshard rank
+                if rank not in rs["leaving"]:
+                    rs["leaving"][rank] = deadline
+                    rs["target_world"] = max(1, int(rs["target_world"]) - 1)
+                P.send_msg(sock, P.MSG_OK, {
+                    "reshard": True, "generation": self.generation,
+                    "target_world": rs["target_world"],
+                    "target_samples": rs["targets"].get(rank),
+                })
+                return
+            if world <= 1:
+                lease["owner"] = None
+                P.send_msg(sock, P.MSG_OK, {
+                    "reshard": False, "generation": self.generation,
+                    "detail": "world is 1; nothing to reshard down to",
+                })
+                return
+        if not self._trigger_reshard(world - 1, leaving={rank: deadline}):
+            # lost a race with a concurrent trigger; the client's retry
+            # joins that barrier through the branch above
+            P.send_msg(sock, P.MSG_ERROR, {
+                "code": "reshard", "retry_ms": 20,
+                "detail": "another reshard started concurrently; retry",
+            })
+            return
+        with self._lock:
+            rs = self._reshard
+            hdr = {"reshard": True, "generation": self.generation,
+                   "target_world": (rs["target_world"] if rs is not None
+                                    else self.spec.world),
+                   "target_samples": (rs["targets"].get(rank)
+                                      if rs is not None else None)}
+        P.send_msg(sock, P.MSG_OK, hdr)
+
+    def _on_reshard(self, sock, conn_id, header) -> None:
+        """Explicit world change.  One barrier at a time: a second
+        request while one drains draws ``ERROR(code='reshard')`` and the
+        retry layer waits the first one out."""
+        try:
+            new_world = int(header["world"])
+        except (KeyError, TypeError, ValueError):
+            P.send_msg(sock, P.MSG_ERROR,
+                       {"code": "bad_request",
+                        "detail": "RESHARD needs an int world"})
+            return
+        if new_world < 1:
+            P.send_msg(sock, P.MSG_ERROR,
+                       {"code": "bad_request",
+                        "detail": f"world must be >= 1, got {new_world}"})
+            return
+        if not self._trigger_reshard(new_world):
+            P.send_msg(sock, P.MSG_ERROR, {
+                "code": "reshard", "retry_ms": 50,
+                "detail": "a reshard is already draining; retry",
+            })
+            return
+        with self._lock:
+            rs = self._reshard
+            hdr = {"generation": self.generation, "world": self.spec.world,
+                   "target_world": new_world, "committed": rs is None}
+            if rs is not None:
+                hdr["barrier_units"] = rs.get("barrier_units")
+                hdr["epoch"] = rs.get("epoch")
+        P.send_msg(sock, P.MSG_OK, hdr)
+
     # ---------------------------------------------------------------- HELLO
     def _on_hello(self, sock, conn_id, header) -> None:
         proto = header.get("proto")
         if proto != P.PROTOCOL_VERSION:
+            # explicit version negotiation: a mismatched peer gets the
+            # typed error with BOTH ints, never undefined frame decoding
             P.send_msg(sock, P.MSG_ERROR, {
-                "code": "proto",
+                "code": "protocol_version",
+                "server_proto": P.PROTOCOL_VERSION,
+                "client_proto": proto,
                 "detail": f"server speaks protocol {P.PROTOCOL_VERSION}, "
                           f"client sent {proto!r}",
             })
@@ -430,7 +911,10 @@ class IndexServer:
             })
             return
         fp = header.get("spec_fingerprint")
-        if fp is not None and fp != self.spec.fingerprint():
+        if fp is not None and \
+                fp != self.spec.fingerprint(include_world=False):
+            # membership-aware identity: the world is authoritative server
+            # state once resharding exists, so peers compare it stripped
             P.send_msg(sock, P.MSG_ERROR, {
                 "code": "spec",
                 "detail": "client and server stream specs differ; refusing "
@@ -447,6 +931,13 @@ class IndexServer:
         want = -1 if want is None else int(want)
         now = self._clock()
         with self._lock:
+            if want >= self.spec.world and self.generation > 0:
+                # a pre-reshard client coming back for a rank the commit
+                # removed: tell it the world changed rather than "no_rank"
+                P.send_msg(sock, P.MSG_ERROR, self._resharded_err_locked(
+                    f"rank {want} no longer exists at world "
+                    f"{self.spec.world}; rejoin with rank=-1"))
+                return
             rank = self._claim_rank(want, conn_id, now)
             if rank is None:
                 code = "rank_taken" if 0 <= want < self.spec.world \
@@ -461,20 +952,20 @@ class IndexServer:
             self._leases[rank]["batch"] = batch
             if rank in self._cursors:
                 self.metrics.inc("reconnects", rank)
-            epoch = self.epoch
+            welcome = {
+                "proto": P.PROTOCOL_VERSION,
+                "rank": rank,
+                "spec": self.spec.to_wire(),
+                **self._membership_locked(),
+            }
         self._write_snapshot()
-        P.send_msg(sock, P.MSG_WELCOME, {
-            "proto": P.PROTOCOL_VERSION,
-            "rank": rank,
-            "world": self.spec.world,
-            "epoch": epoch,
-            "spec": self.spec.to_wire(),
-        })
+        P.send_msg(sock, P.MSG_WELCOME, welcome)
 
     def _claim_rank(self, want: int, conn_id: int, now: float):
         """Grant ``want`` (or the lowest free rank for -1).  Called under
         ``self._lock``.  A stale live lease is evicted on the spot."""
         candidates = ([want] if want >= 0 else range(self.spec.world))
+        fresh = want < 0 and self.generation > 0
         for rank in candidates:
             if not 0 <= rank < self.spec.world:
                 return None
@@ -484,9 +975,20 @@ class IndexServer:
                     continue  # genuinely live
                 lease["owner"] = None
                 self.metrics.inc("evictions", rank)
+            if fresh:
+                cur = self._cursors.get(rank)
+                if cur is not None and int(cur.get("samples", 0)) > 0:
+                    # post-reshard auto-claims start at seq 0, so a slot
+                    # whose current-generation stream is already partly
+                    # served (its previous owner completed or died after
+                    # pulling batches) would be double-delivered — only
+                    # unserved slots (a leaver's freed lease, a grown
+                    # world's new ranks) are adoptable fresh
+                    continue
             self._leases[rank] = {"owner": conn_id, "last_seen": now,
                                   "batch": self._leases.get(rank, {}).get(
                                       "batch", 0)}
+            self._vacated.pop(rank, None)
             return rank
         return None
 
@@ -505,7 +1007,22 @@ class IndexServer:
             P.send_msg(sock, P.MSG_ERROR,
                        {"code": "bad_request", "detail": f"seq {seq} < 0"})
             return
+        gen = int(header.get("gen", 0))
         with self._lock:
+            if gen != self.generation:
+                # the request names a stream of a committed-away
+                # generation: hand the client the membership to adopt
+                P.send_msg(sock, P.MSG_ERROR, self._resharded_err_locked(
+                    f"generation {gen} was resharded away (now at "
+                    f"{self.generation})"))
+                return
+            rs = self._reshard
+            if rs is not None and rs.get("phase") == "freeze":
+                P.send_msg(sock, P.MSG_ERROR, {
+                    "code": "reshard", "retry_ms": 20,
+                    "detail": "reshard barrier is freezing; retry shortly",
+                })
+                return
             lease = self._leases.get(rank)
             if lease is None or lease.get("owner") != conn_id:
                 P.send_msg(sock, P.MSG_ERROR, {
@@ -519,7 +1036,7 @@ class IndexServer:
             cur = self._cursors.get(rank)
             if cur is None or cur["epoch"] != epoch:
                 cur = self._cursors[rank] = {"epoch": epoch, "acked": -1,
-                                             "hi": -1}
+                                             "hi": -1, "samples": 0}
             ack = header.get("ack")
             if ack is not None:
                 cur["acked"] = max(cur["acked"], int(ack))
@@ -533,23 +1050,85 @@ class IndexServer:
                     "retry_ms": 20,
                 })
                 return
+            clamp = None
+            if (rs is not None and rs.get("phase") == "drain"
+                    and epoch == rs["epoch"] and rank in rs["targets"]):
+                t = int(rs["targets"][rank])
+                if seq * batch >= t:
+                    # the rank has drained its pre-barrier allocation
+                    rs["drained"].add(rank)
+                    leaving = rank in rs["leaving"]
+                    try:
+                        self._commit_reshard_locked()
+                    except F.InjectedThreadDeath:
+                        raise
+                    except Exception:
+                        pass  # commit fault: drain intact, sweep retries
+                    if leaving:
+                        # terminal EOF: the leaving client's stream ends
+                        reply = (P.MSG_BATCH,
+                                 {"seq": seq, "eof": True, "total": t,
+                                  "end": t, "left": True}, b"")
+                    elif gen != self.generation:
+                        reply = (P.MSG_ERROR, self._resharded_err_locked(
+                            "reshard committed; adopt the new membership"),
+                            b"")
+                    else:
+                        reply = (P.MSG_ERROR, {
+                            "code": "reshard", "retry_ms": 20,
+                            "detail": f"rank {rank} drained to its barrier "
+                                      "target; waiting for the commit",
+                        }, b"")
+                    mt, h, pl = reply
+                    P.send_msg(sock, mt, h, pl)
+                    return
+                clamp = t
             resend = seq <= cur["hi"]
         arr = self._rank_array(epoch, rank)
         lo = seq * batch
         total = int(arr.shape[0])
-        if lo >= total:
+        limit = total if clamp is None else min(clamp, total)
+        if lo >= limit:
             P.send_msg(sock, P.MSG_BATCH,
-                       {"seq": seq, "eof": True, "total": total})
+                       {"seq": seq, "eof": True, "total": total,
+                        "end": limit, "gen": gen})
             return
-        fields, payload = P.encode_indices(arr[lo:lo + batch])
+        sl = arr[lo:min(lo + batch, limit)]
+        end = lo + int(sl.shape[0])
+        fields, payload = P.encode_indices(sl)
         with self._lock:
-            cur = self._cursors.get(rank)
-            if cur is not None and cur["epoch"] == epoch:
-                cur["hi"] = max(cur["hi"], seq)
+            if gen != self.generation:
+                # a concurrent sweep committed while we were encoding —
+                # serving old-generation bytes now could duplicate an
+                # orphaned span, so refuse and hand over the membership
+                stale = self._resharded_err_locked(
+                    "reshard committed mid-request; adopt the new "
+                    "membership")
+            else:
+                stale = None
+                cur = self._cursors.get(rank)
+                if cur is not None and cur["epoch"] == epoch:
+                    cur["hi"] = max(cur["hi"], seq)
+                    cur["samples"] = max(int(cur.get("samples", 0)), end)
+                rs = self._reshard
+                if (rs is not None and rs.get("phase") == "drain"
+                        and epoch == rs["epoch"] and rank in rs["targets"]
+                        and end >= int(rs["targets"][rank])):
+                    rs["drained"].add(rank)
+                    try:
+                        self._commit_reshard_locked()
+                    except F.InjectedThreadDeath:
+                        raise
+                    except Exception:
+                        pass
+        if stale is not None:
+            P.send_msg(sock, P.MSG_ERROR, stale)
+            return
         self.metrics.inc("batches_served", rank)
         if resend:
             self.metrics.inc("resends", rank)
         self._write_snapshot()
         P.send_msg(sock, P.MSG_BATCH,
-                   {"seq": seq, "eof": False, "total": total, **fields},
+                   {"seq": seq, "eof": False, "total": total, "end": end,
+                    "gen": gen, **fields},
                    payload)

@@ -167,21 +167,44 @@ class PartialShuffleSpec:
         return None
 
     # ------------------------------------------------------------- streams
-    def rank_indices(self, epoch: int, rank: int) -> np.ndarray:
+    def rank_indices(self, epoch: int, rank: int, *,
+                     layers=None) -> np.ndarray:
         """The rank's full epoch stream as host sample indices — the
-        normative stream every consumer surface of this config serves."""
+        normative stream every consumer surface of this config serves.
+
+        ``layers`` names a §6 elastic reshard cascade
+        (``[(old_world, consumed), ...]`` outermost first, consumed counted
+        in this spec's base units: samples for plain/mixture, SHARDS for
+        shard mode); the stream is then the epoch's remainder after the
+        cascade, partitioned at this spec's (new) ``world``."""
         if not 0 <= rank < self.world:
             raise ValueError(f"rank must be in [0, {self.world}), got {rank}")
         epoch = int(epoch)
+        layers = None if not layers else [(int(w), int(c)) for w, c in layers]
         if self.mode == "mixture":
-            return self._mixture_indices(epoch, rank)
-        from ..ops import epoch_indices_host
-
+            return self._mixture_indices(epoch, rank, layers)
         n = self.n if self.mode == "plain" else len(self.shard_sizes)
-        base = epoch_indices_host(
-            self.backend, n, self.window, self.seed, epoch, rank, self.world,
-            **self.kwargs,
-        )
+        if layers is not None:
+            from ..ops.cpu import elastic_indices_np
+
+            # the numpy reference derivation is normative and bit-identical
+            # across backends, and remainder domains are small — no reason
+            # to route the cascade through per-backend evaluators
+            base = elastic_indices_np(
+                n, self.window, self.seed, epoch, rank, self.world, layers,
+                shuffle=self.kwargs.get("shuffle", True),
+                drop_last=self.kwargs.get("drop_last", False),
+                order_windows=self.kwargs.get("order_windows", True),
+                partition=self.kwargs.get("partition", "strided"),
+                rounds=self.kwargs.get("rounds", core.DEFAULT_ROUNDS),
+            )
+        else:
+            from ..ops import epoch_indices_host
+
+            base = epoch_indices_host(
+                self.backend, n, self.window, self.seed, epoch, rank,
+                self.world, **self.kwargs,
+            )
         if self.mode == "plain":
             return base
         if self.backend == "native":
@@ -194,7 +217,37 @@ class PartialShuffleSpec:
             rounds=self.kwargs.get("rounds", core.DEFAULT_ROUNDS),
         )
 
-    def _mixture_indices(self, epoch: int, rank: int) -> np.ndarray:
+    def rank_unit_sizes(self, epoch: int, rank: int, *, layers=None):
+        """Per-base-unit sample counts of the rank's stream, or ``None``
+        when units ARE samples (plain/mixture).  For shard mode this is
+        ``shard_sizes[shard_draw]`` — what a consumption watermark in
+        samples needs to be converted to whole consumed SHARDS (the unit
+        an elastic barrier must cut on, service/server.py)."""
+        if self.mode != "shard":
+            return None
+        if layers is not None:
+            from ..ops.cpu import elastic_indices_np
+
+            ids = elastic_indices_np(
+                len(self.shard_sizes), self.window, self.seed, int(epoch),
+                rank, self.world, [(int(w), int(c)) for w, c in layers],
+                shuffle=self.kwargs.get("shuffle", True),
+                drop_last=self.kwargs.get("drop_last", False),
+                order_windows=self.kwargs.get("order_windows", True),
+                partition=self.kwargs.get("partition", "strided"),
+                rounds=self.kwargs.get("rounds", core.DEFAULT_ROUNDS),
+            )
+        else:
+            from ..ops import epoch_indices_host
+
+            ids = epoch_indices_host(
+                self.backend, len(self.shard_sizes), self.window, self.seed,
+                int(epoch), rank, self.world, **self.kwargs,
+            )
+        return np.asarray(self.shard_sizes)[np.asarray(ids)]
+
+    def _mixture_indices(self, epoch: int, rank: int,
+                         layers=None) -> np.ndarray:
         from ..ops import mixture as M
 
         kw = dict(
@@ -205,6 +258,23 @@ class PartialShuffleSpec:
             partition=self.kwargs.get("partition", "strided"),
             rounds=self.kwargs.get("rounds", core.DEFAULT_ROUNDS),
         )
+        if layers is not None:
+            if self.backend == "xla":
+                return np.asarray(M.mixture_elastic_indices_jax(
+                    self._mixture_spec, self.seed, epoch, rank, self.world,
+                    layers, **kw,
+                ))
+            if self.backend == "native":
+                from ..ops.native import mixture_elastic_indices_native
+
+                return mixture_elastic_indices_native(
+                    self._mixture_spec, self.seed, epoch, rank, self.world,
+                    layers, **kw,
+                )
+            return M.mixture_elastic_indices_np(
+                self._mixture_spec, self.seed, epoch, rank, self.world,
+                layers, **kw,
+            )
         if self.backend == "xla":
             return np.asarray(M.mixture_epoch_indices_jax(
                 self._mixture_spec, self.seed, epoch, rank, self.world, **kw,
@@ -254,9 +324,30 @@ class PartialShuffleSpec:
                                 mk[3], mk[4])
         return cls(d.pop("mode"), backend=backend, **d, **kwargs)
 
-    def fingerprint(self) -> str:
-        return json.dumps(self.to_wire(), sort_keys=True,
-                          separators=(",", ":"))
+    def with_world(self, world: int) -> "PartialShuffleSpec":
+        """The same stream identity re-partitioned at a different world —
+        what an elastic reshard commit produces (the fingerprint modulo
+        ``world`` is unchanged)."""
+        world = int(world)
+        if world == self.world:
+            return self
+        wire = self.to_wire()
+        wire["world"] = world
+        out = self.from_wire(wire, backend=self.backend)
+        # pure speed knob, excluded from the wire form — carry it across
+        if "use_pallas" in self.kwargs:
+            out.kwargs["use_pallas"] = self.kwargs["use_pallas"]
+        return out
+
+    def fingerprint(self, *, include_world: bool = True) -> str:
+        """Stable string of the wire form.  ``include_world=False`` names
+        the stream identity independent of the current partition width —
+        the membership-aware comparison elastic peers use (the world is
+        authoritative server state once resharding is possible)."""
+        wire = self.to_wire()
+        if not include_world:
+            wire.pop("world")
+        return json.dumps(wire, sort_keys=True, separators=(",", ":"))
 
     def __eq__(self, other) -> bool:
         return (isinstance(other, PartialShuffleSpec)

@@ -448,6 +448,95 @@ def test_client_survives_drain_window_across_restart():
     assert np.array_equal(np.concatenate(got), ref)
 
 
+# ------------------------------------------------- elastic membership faults
+# (site, kind, rule kwargs): nth=1 lands on the barrier trigger, nth=2 on
+# the commit (which fires only once every drain participant arrived) —
+# both before any state mutation, so a retry always finds clean state
+_ELASTIC_FAULTS = [
+    ("server.reshard", "delay", dict(nth=1, count=2, delay_s=0.01)),
+    ("server.reshard", "reset", dict(nth=1, count=1)),
+    ("server.reshard", "thread_death", dict(nth=1, count=1)),
+    ("server.reshard", "reset", dict(nth=2, count=1)),
+    ("client.leave", "delay", dict(nth=1, count=1, delay_s=0.01)),
+    ("client.leave", "reset", dict(nth=1, count=1)),
+    ("client.leave", "error", dict(nth=1, count=1)),
+    ("client.leave", "thread_death", dict(nth=1, count=1)),
+]
+
+
+@pytest.mark.parametrize("mode", sorted(SPECS))
+@pytest.mark.parametrize(
+    "site,kind,rule_kw", _ELASTIC_FAULTS,
+    ids=[f"{s}-{k}-nth{kw.get('nth', 1)}" for s, k, kw in _ELASTIC_FAULTS])
+def test_elastic_fault_matrix_exactly_once(mode, site, kind, rule_kw):
+    """Faults at the reshard trigger, the barrier commit, or the LEAVE
+    call itself: the epoch union stays exactly the uninterrupted stream
+    (2 -> 1 has no wrap-pad) — a fault either delays the world change or
+    aborts it cleanly as a typed error, never tears it half-applied."""
+    spec = SPECS[mode](world=2)
+    ref = np.concatenate([np.asarray(spec.rank_indices(0, r))
+                          for r in range(2)])
+    op = "leave" if site == "client.leave" else "reshard"
+    plan = F.FaultPlan([F.FaultRule(site=site, kind=kind, **rule_kw)])
+    delivered = {}
+    aborted = []
+    lock = threading.Lock()
+    b_hit = threading.Barrier(2)
+    b_go = threading.Barrier(2)
+    with plan:
+        with IndexServer(spec) as srv:
+
+            def worker(r):
+                got = []
+                c = ServiceIndexClient(srv.address, rank=r, batch=31,
+                                       backoff_base=0.01,
+                                       reconnect_timeout=15.0)
+                try:
+                    it = c.epoch_batches(0)
+                    for _ in range(1 + r):
+                        got.append(next(it))
+                    b_hit.wait(timeout=30.0)
+                    if r == 0:
+                        try:
+                            if op == "leave":
+                                c.leave(grace_ms=60_000)
+                            else:
+                                c.reshard(1)
+                        except (F.InjectedFault, F.InjectedThreadDeath,
+                                ConnectionError) as exc:
+                            with lock:
+                                aborted.append(exc)
+                    b_go.wait(timeout=30.0)
+                    for arr in it:
+                        got.append(arr)
+                finally:
+                    with lock:
+                        delivered[r] = got
+                    c.close()
+
+            threads = [threading.Thread(target=worker, args=(r,))
+                       for r in range(2)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60.0)
+                assert not t.is_alive(), "elastic chaos worker hung"
+            generation = srv._state_dict()["generation"]
+    assert plan.fired(site) > 0, "fault never fired; the test is vacuous"
+    if aborted:
+        # the LEAVE died client-side before reaching the daemon: the
+        # world must be untouched and both ranks finish their epoch
+        assert site == "client.leave"
+        assert generation == 0
+    else:
+        assert generation == 1, "world change lost under injected fault"
+    union = np.concatenate(
+        [np.concatenate(v) if v else np.empty(0, np.int64)
+         for v in delivered.values()])
+    assert np.array_equal(np.sort(union), np.sort(ref)), (
+        f"stream not exactly-once under {kind} at {site}")
+
+
 # ---------------------------------------------------------- snapshot faults
 def test_snapshot_disk_full_does_not_stop_serving(tmp_path):
     spec = plain_spec(world=1)
