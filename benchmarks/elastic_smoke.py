@@ -61,12 +61,17 @@ def _reshard_latency_ms(old_world: int, new_world: int, *, n: int = 20_000,
         for it in its:
             delivered.append(next(it))
             delivered.append(next(it))
+        for c in clients:
+            # flush the delivered-ack cursors: the barrier commits on
+            # ACKED delivery, so with every rank acked at an equal
+            # watermark the commit happens inside the trigger itself
+            c.heartbeat()
         t0 = time.perf_counter()
         rep = clients[0].reshard(new_world)
         barrier_ms = (time.perf_counter() - t0) * 1e3
         if rep["committed"] is not True:
             raise AssertionError(
-                "equal watermarks must commit inside the trigger")
+                "equal acked watermarks must commit inside the trigger")
         t1 = time.perf_counter()
         first = next(its[0])  # adopts `resharded`, re-requests at gen+1
         first_batch_ms = (time.perf_counter() - t1) * 1e3

@@ -493,7 +493,14 @@ class ServiceIndexClient:
         return self.server_epoch
 
     def heartbeat(self) -> None:
-        self._rpc(P.MSG_HEARTBEAT, {"rank": self.rank})
+        """Keepalive; also carries the delivered-ack cursor, so an idle
+        client still completes an elastic drain — the barrier commits on
+        *acked* delivery, not on served bytes."""
+        header = {"rank": self.rank}
+        if self._cursor["epoch"] is not None:
+            header["epoch"] = int(self._cursor["epoch"])
+            header["ack"] = int(self._cursor["seq"]) - 1
+        self._rpc(P.MSG_HEARTBEAT, header)
 
     def snapshot(self) -> dict:
         _, header, _ = self._rpc(P.MSG_SNAPSHOT, {})

@@ -37,9 +37,14 @@ framing).  Design points, in the order they matter:
   tracked by the batch cursors are converted to whole consumed base
   units (samples, or SHARDS for shard mode), the barrier is their max
   ``C``, and every live rank drains — keeps being served its old
-  partition, clamped to the barrier's per-rank sample target.  When all
-  participants have drained (dead ones become *orphan* descriptors,
-  served later as a prefix of rank 0's stream), the server appends the
+  partition, clamped to the barrier's per-rank sample target.  A rank
+  counts as drained only once the client has *acked* delivery of its
+  full pre-barrier span (via ``GET_BATCH``'s ack, or a ``HEARTBEAT``
+  carrying the cursor when the client is idle) — a served-but-lost
+  final reply stays resendable instead of being dropped by the commit.
+  When all participants have drained (dead ones become *orphan*
+  descriptors, served later as a prefix of rank 0's stream), the server
+  appends the
   ``(old_world, C)`` cascade layer from SPEC.md §6, re-partitions the
   remainder at the new world via ``ops.core``'s reshard chain, and bumps
   its ``generation``; requests stamped with a stale generation draw
@@ -332,6 +337,17 @@ class IndexServer:
                     "dead": {int(r) for r in rs.get("dead", [])},
                     "leaving": {int(r): None for r in rs.get("leaving", [])},
                 }
+                # every lease is vacant after a restart: put each
+                # un-drained participant on the membership_timeout clock
+                # now, so a participant that never reconnects (its grace
+                # deadline did not survive the restart either) is
+                # eventually declared dead instead of deadlocking the
+                # barrier for every survivor
+                now = self._clock()
+                for r in self._reshard["targets"]:
+                    if (r not in self._reshard["drained"]
+                            and r not in self._reshard["dead"]):
+                        self._vacated.setdefault(r, now)
 
     def _write_snapshot(self, force: bool = False) -> None:
         if not self.snapshot_path:
@@ -585,13 +601,7 @@ class IndexServer:
             self._write_snapshot(force=True)
             P.send_msg(sock, P.MSG_OK, {"epoch": self.epoch})
         elif msg == P.MSG_HEARTBEAT:
-            rank = header.get("rank")
-            with self._lock:
-                lease = self._leases.get(int(rank)) if rank is not None \
-                    else None
-                if lease is not None and lease.get("owner") == conn_id:
-                    self._touch(int(rank), lease)
-            P.send_msg(sock, P.MSG_OK, {})
+            self._on_heartbeat(sock, conn_id, header)
         elif msg == P.MSG_SNAPSHOT:
             self._write_snapshot(force=True)
             P.send_msg(sock, P.MSG_SNAPSHOT_STATE,
@@ -608,6 +618,46 @@ class IndexServer:
                 "code": "unknown_type",
                 "detail": f"message type {P.msg_name(msg)} not served",
             })
+
+    def _on_heartbeat(self, sock, conn_id, header) -> None:
+        """Keepalive, optionally carrying the client's delivered-ack
+        cursor (``epoch`` + ``ack``).  The ack matters during a drain:
+        the barrier commits on ACKED delivery, and a participant that
+        stopped pulling batches (idle at its watermark when the barrier
+        froze) would otherwise never deliver the final ack that
+        completes its drain."""
+        rank = header.get("rank")
+        committed = False
+        with self._lock:
+            lease = self._leases.get(int(rank)) if rank is not None \
+                else None
+            if lease is not None and lease.get("owner") == conn_id:
+                rank = int(rank)
+                self._touch(rank, lease)
+                ack, epoch = header.get("ack"), header.get("epoch")
+                if ack is not None and epoch is not None:
+                    cur = self._cursors.get(rank)
+                    if cur is not None and cur["epoch"] == int(epoch):
+                        cur["acked"] = max(cur["acked"], int(ack))
+                        rs = self._reshard
+                        if (rs is not None and rs.get("phase") == "drain"
+                                and int(epoch) == rs["epoch"]
+                                and rank in rs["targets"]
+                                and rank not in rs["drained"]
+                                and (cur["acked"] + 1)
+                                * int(lease.get("batch") or 0)
+                                >= int(rs["targets"][rank])):
+                            rs["drained"].add(rank)
+                            try:
+                                committed = self._commit_reshard_locked()
+                            except F.InjectedThreadDeath:
+                                raise
+                            except Exception:
+                                pass  # commit fault: drain intact, retried
+            gen = self.generation
+        if committed:
+            self._write_snapshot(force=True)
+        P.send_msg(sock, P.MSG_OK, {"generation": gen})
 
     # ------------------------------------------------- elastic membership
     def _membership_locked(self) -> dict:
@@ -636,6 +686,9 @@ class IndexServer:
         expansion is exactly the expansion of the remainder shard IDs).
         Ranks behind ``C`` keep being served their old partition, clamped
         to their per-rank sample target; ranks at it wait out the commit.
+        A rank counts as drained only up to its ACKED delivery — the
+        served watermark may lead it by one lost-in-flight reply, and
+        that span must stay resendable past the commit.
         Returns False when another reshard is already in flight."""
         F.fire("server.reshard")
         target_world = int(target_world)
@@ -659,9 +712,20 @@ class IndexServer:
                     and self._cursors[r]["epoch"] == epoch else 0)
                 for r in range(world)
             }
+            covered = {}
+            for r in range(world):
+                cur = self._cursors.get(r)
+                b = int(self._leases.get(r, {}).get("batch") or 0)
+                covered[r] = (
+                    (int(cur["acked"]) + 1) * b
+                    if cur is not None and cur["epoch"] == epoch and b > 0
+                    else 0
+                )
         try:
             # unit structure may regenerate shard draws — outside the lock
-            # (the freeze phase pauses serving, so watermarks cannot move)
+            # (the freeze phase pauses serving, so watermarks cannot move:
+            # new requests are refused at admission, and a request already
+            # past admission is refused at its counting tail)
             shard = self.spec.mode == "shard"
             cums = {}
             if shard:
@@ -676,29 +740,41 @@ class IndexServer:
                 units[r] = (int(np.searchsorted(cums[r], s, side="left"))
                             if shard else s)
             barrier = max(units.values(), default=0)
+            with self._lock:
+                rs = self._reshard
+                targets = {}
+                now = self._clock()
+                for r in range(world):
+                    t = int(cums[r][barrier]) if shard else int(barrier)
+                    if r == 0:
+                        t += orphan_len
+                    targets[r] = t
+                    lease = self._leases.get(r)
+                    if lease is None or lease.get("owner") is None:
+                        # a participant with no live lease at the barrier
+                        # goes on the membership_timeout clock NOW — a
+                        # rank that never connected at all would otherwise
+                        # never be declared dead and stall the drain
+                        self._vacated.setdefault(r, now)
+                rs.update(
+                    phase="drain",
+                    barrier_units=int(barrier),
+                    targets=targets,
+                    drained={r for r in range(world)
+                             if r not in set(dead or ()) and
+                             covered[r] >= targets[r]},
+                    leaving=dict(leaving or {}),
+                    dead=set(dead or ()),
+                )
+                self.metrics.inc("reshard_triggers")
         except BaseException:
+            # any failure between the freeze and the drain flip (shard
+            # regen, target computation) must unfreeze, or every future
+            # GET_BATCH draws an endless retry and the server is bricked
             with self._lock:
                 self._reshard = None
             raise
         with self._lock:
-            rs = self._reshard
-            targets = {}
-            for r in range(world):
-                t = int(cums[r][barrier]) if shard else int(barrier)
-                if r == 0:
-                    t += orphan_len
-                targets[r] = t
-            rs.update(
-                phase="drain",
-                barrier_units=int(barrier),
-                targets=targets,
-                drained={r for r in range(world)
-                         if r not in set(dead or ()) and
-                         samples[r] >= targets[r]},
-                leaving=dict(leaving or {}),
-                dead=set(dead or ()),
-            )
-            self.metrics.inc("reshard_triggers")
             try:
                 self._commit_reshard_locked()
             except F.InjectedThreadDeath:
@@ -1051,39 +1127,59 @@ class IndexServer:
                 })
                 return
             clamp = None
+            reply = None
+            committed = False
             if (rs is not None and rs.get("phase") == "drain"
                     and epoch == rs["epoch"] and rank in rs["targets"]):
                 t = int(rs["targets"][rank])
                 if seq * batch >= t:
-                    # the rank has drained its pre-barrier allocation
-                    rs["drained"].add(rank)
-                    leaving = rank in rs["leaving"]
-                    try:
-                        self._commit_reshard_locked()
-                    except F.InjectedThreadDeath:
-                        raise
-                    except Exception:
-                        pass  # commit fault: drain intact, sweep retries
-                    if leaving:
-                        # terminal EOF: the leaving client's stream ends
-                        reply = (P.MSG_BATCH,
-                                 {"seq": seq, "eof": True, "total": t,
-                                  "end": t, "left": True}, b"")
-                    elif gen != self.generation:
-                        reply = (P.MSG_ERROR, self._resharded_err_locked(
-                            "reshard committed; adopt the new membership"),
-                            b"")
-                    else:
+                    if (cur["acked"] + 1) * batch < t:
+                        # past the target, but delivery of the pre-barrier
+                        # tail is not acked — a served-but-lost final
+                        # reply must stay resendable, so the drain
+                        # completes only on the client's ack
                         reply = (P.MSG_ERROR, {
                             "code": "reshard", "retry_ms": 20,
-                            "detail": f"rank {rank} drained to its barrier "
-                                      "target; waiting for the commit",
+                            "detail": f"rank {rank} reached its barrier "
+                                      "target without acking the full "
+                                      "pre-barrier span; retry",
                         }, b"")
-                    mt, h, pl = reply
-                    P.send_msg(sock, mt, h, pl)
-                    return
-                clamp = t
+                    else:
+                        # the rank ACKED its full pre-barrier allocation
+                        rs["drained"].add(rank)
+                        leaving = rank in rs["leaving"]
+                        try:
+                            committed = self._commit_reshard_locked()
+                        except F.InjectedThreadDeath:
+                            raise
+                        except Exception:
+                            pass  # commit fault: drain intact, retried
+                        if leaving:
+                            # terminal EOF: the leaving stream ends
+                            reply = (P.MSG_BATCH,
+                                     {"seq": seq, "eof": True, "total": t,
+                                      "end": t, "left": True}, b"")
+                        elif gen != self.generation:
+                            reply = (P.MSG_ERROR,
+                                     self._resharded_err_locked(
+                                         "reshard committed; adopt the "
+                                         "new membership"), b"")
+                        else:
+                            reply = (P.MSG_ERROR, {
+                                "code": "reshard", "retry_ms": 20,
+                                "detail": f"rank {rank} drained to its "
+                                          "barrier target; waiting for "
+                                          "the commit",
+                            }, b"")
+                else:
+                    clamp = t
             resend = seq <= cur["hi"]
+        if reply is not None:
+            if committed:
+                self._write_snapshot(force=True)
+            mt, h, pl = reply
+            P.send_msg(sock, mt, h, pl)
+            return
         arr = self._rank_array(epoch, rank)
         lo = seq * batch
         total = int(arr.shape[0])
@@ -1097,6 +1193,8 @@ class IndexServer:
         end = lo + int(sl.shape[0])
         fields, payload = P.encode_indices(sl)
         with self._lock:
+            stale = None
+            rs = self._reshard
             if gen != self.generation:
                 # a concurrent sweep committed while we were encoding —
                 # serving old-generation bytes now could duplicate an
@@ -1104,23 +1202,29 @@ class IndexServer:
                 stale = self._resharded_err_locked(
                     "reshard committed mid-request; adopt the new "
                     "membership")
+            elif rs is not None and rs.get("phase") == "freeze":
+                # a barrier froze while we were generating/encoding:
+                # delivering now would outrun the watermark snapshot the
+                # freeze took (the span would also ride the repartitioned
+                # remainder, i.e. be served twice) — refuse; the retry is
+                # served clamped once the drain opens
+                stale = {"code": "reshard", "retry_ms": 20,
+                         "detail": "reshard barrier froze mid-request; "
+                                   "retry shortly"}
+            elif (rs is not None and rs.get("phase") == "drain"
+                    and epoch == rs["epoch"] and rank in rs["targets"]
+                    and clamp is None and end > int(rs["targets"][rank])):
+                # same race, one tick later: the barrier froze AND opened
+                # its drain mid-request, and this unclamped slice overruns
+                # the rank's drain target — refuse rather than duplicate
+                stale = {"code": "reshard", "retry_ms": 20,
+                         "detail": "reshard barrier cut below this batch "
+                                   "mid-request; retry shortly"}
             else:
-                stale = None
                 cur = self._cursors.get(rank)
                 if cur is not None and cur["epoch"] == epoch:
                     cur["hi"] = max(cur["hi"], seq)
                     cur["samples"] = max(int(cur.get("samples", 0)), end)
-                rs = self._reshard
-                if (rs is not None and rs.get("phase") == "drain"
-                        and epoch == rs["epoch"] and rank in rs["targets"]
-                        and end >= int(rs["targets"][rank])):
-                    rs["drained"].add(rank)
-                    try:
-                        self._commit_reshard_locked()
-                    except F.InjectedThreadDeath:
-                        raise
-                    except Exception:
-                        pass
         if stale is not None:
             P.send_msg(sock, P.MSG_ERROR, stale)
             return

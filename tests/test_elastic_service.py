@@ -232,6 +232,7 @@ def test_leave_grace_expiry_orphans_the_remainder():
         got1 = [next(it1), next(it1)]
         rep = c0.leave(grace_ms=100)
         assert rep["reshard"] is True
+        c1.heartbeat()  # flush the survivor's delivered ack: it drains
         # the leaver goes silent instead of draining; its grace expires
         clk.t += 1.0
         srv._sweep_leases()
@@ -267,7 +268,8 @@ def test_membership_timeout_evicts_vacant_rank_and_reshards():
         it1 = c1.epoch_batches(0)
         got1 = [next(it1), next(it1)]
         clk.t += 6.0
-        srv._sweep_leases()
+        srv._sweep_leases()  # triggers the eviction reshard (drain phase)
+        c1.heartbeat()       # survivor's delivered ack completes the drain
         snap = srv._state_dict()
         assert snap["generation"] == 1, "sweep must trigger the reshard"
         assert srv.metrics.report()["counters"].get("reshard_triggers",
@@ -299,21 +301,23 @@ def test_kill_restart_between_barrier_and_first_post_batch(mode, tmp_path):
     try:
         pre = {r: [next(its[r]), next(its[r])] for r in range(4)}
         rep = clients[0].reshard(3)
-        if not rep["committed"]:
-            # shard mode: the barrier cuts on whole SHARDS, so per-rank
-            # sample targets differ — drain each rank to its clamped
-            # target; the last drained batch commits the barrier
-            C = int(rep["barrier_units"])
-            for r in range(4):
-                sizes = np.asarray(spec.rank_unit_sizes(0, r),
-                                   dtype=np.int64)
-                cums = np.concatenate(([0], np.cumsum(sizes)))
-                need = int(cums[C]) - 46
-                while need > 0:
-                    arr = next(its[r])
-                    pre[r].append(arr)
-                    need -= len(arr)
-                assert need == 0, "drain overshot the barrier target"
+        # the barrier commits only on ACKED delivery, and acks trail the
+        # last delivered batch by one request — never inside the trigger
+        assert rep["committed"] is False
+        # drain every rank to its clamped per-rank target (in shard mode
+        # the barrier cuts on whole SHARDS, so the targets differ), then
+        # flush the final delivery acks by heartbeat — the last commits
+        targets = {int(r): int(t)
+                   for r, t in srv._reshard["targets"].items()}
+        for r in range(4):
+            need = targets[r] - 46
+            while need > 0:
+                arr = next(its[r])
+                pre[r].append(arr)
+                need -= len(arr)
+            assert need == 0, "drain overshot the barrier target"
+        for c in clients:
+            c.heartbeat()
         state = json.loads(open(snap_path).read())
         assert state["format"] == 2
         assert state["generation"] == 1
@@ -369,7 +373,9 @@ def test_cascading_reshards_with_restart_between():
         for it in its:
             delivered.append(next(it))
             delivered.append(next(it))
-        assert gen0[0].reshard(3)["committed"] is True
+        assert gen0[0].reshard(3)["committed"] is False
+        for c in gen0:
+            c.heartbeat()  # flush delivery acks; the last one commits
         for c in gen0:
             c.close()
         srv.stop()
@@ -387,7 +393,9 @@ def test_cascading_reshards_with_restart_between():
                 0, r, layers=layers1))[:23]
             assert np.array_equal(arr, want), f"gen1 rank {r}"
             delivered.append(arr)
-        assert gen1[0].reshard(2)["committed"] is True
+        assert gen1[0].reshard(2)["committed"] is False
+        for c in gen1:
+            c.heartbeat()  # flush delivery acks; the last one commits
         state = json.loads(open(snap_path).read())
         assert state["format"] == 2
         assert [tuple(l) for l in state["layers"]] == [(4, 46), (3, 23)]
@@ -464,8 +472,10 @@ def test_fresh_autoclaim_refuses_partially_served_slot():
             it1 = c1.epoch_batches(0)
             got0 = [next(it0), next(it0)]
             got1 = [next(it1), next(it1)]
-            assert c0.reshard(1)["committed"] is True
-            got0.append(next(it0))  # first post-reshard batch: rank 0
+            assert c0.reshard(1)["committed"] is False
+            c1.heartbeat()  # c1's delivered ack: its drain completes
+            got0.append(next(it0))  # c0's own ack commits; first
+            # post-reshard batch arrives through the `resharded` adopt
             c0.close()  # lease freed, but the slot is partly served
             rest1 = list(it1)  # displaced; the only slot is not adoptable
             assert rest1 == []
@@ -491,6 +501,200 @@ def test_protocol_version_mismatch_is_refused_with_both_ints():
     assert header["code"] == "protocol_version"
     assert header["server_proto"] == P.PROTOCOL_VERSION
     assert header["client_proto"] == 1
+
+
+# ----------------------------------------- barrier/delivery race regressions
+def test_freeze_race_does_not_double_serve():
+    """A GET_BATCH already past its admission check when the barrier
+    freezes must not deliver an unclamped batch beyond the frozen
+    watermarks: the counting tail refuses it and the retry is served
+    clamped — no span rides both the pre-commit stream and the
+    repartitioned remainder."""
+    spec = build_spec("plain", 2)
+    ref = epoch_union_ref(spec)
+    srv = IndexServer(spec)
+    srv.start()
+    in_window = threading.Event()
+    go = threading.Event()
+    armed = threading.Event()
+    real = srv._rank_array
+
+    def stalled_rank_array(epoch, rank):
+        arr = real(epoch, rank)
+        if rank == 0 and armed.is_set():
+            # hold THIS request between its admission check and its
+            # counting tail while the barrier freezes underneath it
+            armed.clear()
+            in_window.set()
+            go.wait(timeout=30.0)
+        return arr
+
+    srv._rank_array = stalled_rank_array
+    c0 = ServiceIndexClient(srv.address, rank=0, batch=31,
+                            backoff_base=0.01, reconnect_timeout=20.0)
+    c1 = ServiceIndexClient(srv.address, rank=1, batch=31,
+                            backoff_base=0.01, reconnect_timeout=20.0)
+    got0, got1 = [], []
+    try:
+        it0 = c0.epoch_batches(0)
+        it1 = c1.epoch_batches(0)
+        got0.extend([next(it0), next(it0)])
+        got1.append(next(it1))
+        armed.set()
+        t0 = threading.Thread(target=lambda: got0.extend(it0))
+        t0.start()
+        assert in_window.wait(timeout=30.0), "race window never opened"
+        # barrier freezes at rank 0's watermark 62 while its seq-2
+        # request is paused holding an unclamped [62, 93) slice
+        assert c1.reshard(1)["committed"] is False
+        go.set()
+        got1.extend(it1)  # drains rank 1, commits, bows out displaced
+        t0.join(timeout=60.0)
+        assert not t0.is_alive(), "rank 0 hung riding the freeze race"
+        assert c0.generation == 1 and c0.rank == 0
+    finally:
+        c0.close()
+        c1.close()
+        srv.stop()
+    union = np.concatenate(got0 + got1)
+    # 2 -> 1 has no wrap-pad: any double-served span shows as an extra
+    assert np.array_equal(np.sort(union), np.sort(ref))
+
+
+def test_lost_final_drain_reply_stays_resendable():
+    """The barrier commits on ACKED delivery: a rank whose final
+    pre-barrier reply was lost can resend it after the drain began —
+    the un-acked past-target request draws a retryable error, never a
+    commit that drops the span."""
+    spec = build_spec("plain", 2)
+    with IndexServer(spec) as srv:
+        c1 = ServiceIndexClient(srv.address, rank=1, batch=31,
+                                backoff_base=0.01, reconnect_timeout=10.0)
+        sock = socket.create_connection(srv.address, timeout=5.0)
+        try:
+            P.send_msg(sock, P.MSG_HELLO,
+                       {"proto": P.PROTOCOL_VERSION, "rank": 0,
+                        "batch": 31})
+            msg, header, _ = P.recv_msg(sock)
+            assert msg == P.MSG_WELCOME
+
+            def get(seq, ack):
+                P.send_msg(sock, P.MSG_GET_BATCH,
+                           {"rank": 0, "epoch": 0, "seq": seq,
+                            "ack": ack, "gen": 0})
+                return P.recv_msg(sock)
+
+            _, h0, p0 = get(0, -1)
+            _, h1, p1 = get(1, 0)   # delivered... but imagine it lost
+            it1 = c1.epoch_batches(0)
+            next(it1), next(it1)
+            c1.heartbeat()          # rank 1 acks its full 62: drained
+            assert c1.reshard(1)["committed"] is False
+            # rank 0 asks past its target WITHOUT acking seq 1: the
+            # commit must wait (acked watermark 31 < target 62)
+            msg, h, _ = get(2, 0)
+            assert msg == P.MSG_ERROR and h["code"] == "reshard"
+            assert srv._state_dict()["generation"] == 0
+            # the lost reply is resent, bit-identical, mid-drain
+            msg, h1b, p1b = get(1, 0)
+            assert msg == P.MSG_BATCH and p1b == p1
+            # only the ack past the target completes the drain
+            msg, h, _ = get(2, 1)
+            assert msg == P.MSG_ERROR and h["code"] == "resharded"
+            assert srv._state_dict()["generation"] == 1
+        finally:
+            sock.close()
+            c1.close()
+
+
+def test_restored_drain_times_out_missing_participant(tmp_path):
+    """A daemon restarted mid-drain seeds the membership_timeout clock
+    for every un-drained participant, so a drain whose leaver never
+    reconnects commits (orphaning the remainder) instead of
+    deadlocking every survivor forever."""
+    spec = build_spec("plain", 2)
+    ref = epoch_union_ref(spec)
+    snap_path = str(tmp_path / "snap.json")
+    srv = IndexServer(spec, snapshot_path=snap_path, snapshot_interval=1)
+    host, port = srv.start()
+    c0 = ServiceIndexClient((host, port), rank=0, batch=31,
+                            backoff_base=0.01, reconnect_timeout=10.0)
+    c1 = ServiceIndexClient((host, port), rank=1, batch=31,
+                            backoff_base=0.01, reconnect_timeout=10.0)
+    clk = FakeClock()
+    srv2 = None
+    try:
+        it0 = c0.epoch_batches(0)
+        it1 = c1.epoch_batches(0)
+        got0 = [next(it0)]
+        got1 = [next(it1), next(it1)]
+        assert c0.leave()["reshard"] is True  # no grace bound at all
+        srv.stop()  # killed mid-drain; the leaver never comes back
+        c0.close()
+        srv2 = IndexServer(spec, host=host, port=port,
+                           snapshot_path=snap_path, snapshot_interval=1,
+                           membership_timeout=5.0, clock=clk)
+        srv2.start()
+        assert srv2._reshard is not None
+        # the survivor already served its full pre-barrier target before
+        # the restart; only the delivered ack is outstanding — an idle
+        # heartbeat (re-leasing on reconnect) completes its drain
+        c1.heartbeat()
+        assert srv2._state_dict()["generation"] == 0
+        clk.t += 6.0
+        srv2._sweep_leases()     # vacancy clock expired: rank 0 is dead
+        snap = srv2._state_dict()
+        assert snap["generation"] == 1, "restored drain must time out"
+        assert snap["orphans"], "dead leaver's remainder must be orphaned"
+        got1.extend(it1)         # adopts rank 0: orphan prefix + stream
+        union = np.concatenate(got0 + got1)
+        assert np.array_equal(np.sort(union), np.sort(ref))
+    finally:
+        c0.close()
+        c1.close()
+        srv.stop()
+        if srv2 is not None:
+            srv2.stop()
+
+
+def test_trigger_failure_after_freeze_unfreezes(monkeypatch):
+    """An exception anywhere between the freeze and the drain flip —
+    including the per-rank target computation — resets the in-flight
+    reshard instead of leaving the server frozen (every request drawing
+    an endless retry) until restart."""
+    spec = build_spec("plain", 2)
+    with IndexServer(spec) as srv:
+        c0 = ServiceIndexClient(srv.address, rank=0, batch=31,
+                                backoff_base=0.01, reconnect_timeout=10.0)
+        c1 = ServiceIndexClient(srv.address, rank=1, batch=31,
+                                backoff_base=0.01, reconnect_timeout=10.0)
+        try:
+            it0 = c0.epoch_batches(0)
+            it1 = c1.epoch_batches(0)
+            got0 = [next(it0)]
+            got1 = [next(it1)]
+            real_inc = srv.metrics.inc
+
+            def boom(name, *a, **kw):
+                if name == "reshard_triggers":
+                    raise RuntimeError("injected target-computation fault")
+                return real_inc(name, *a, **kw)
+
+            monkeypatch.setattr(srv.metrics, "inc", boom)
+            with pytest.raises(RuntimeError):
+                srv._trigger_reshard(1)
+            monkeypatch.setattr(srv.metrics, "inc", real_inc)
+            assert srv._reshard is None, "failed trigger left a freeze"
+            # not bricked: both streams still serve to their epoch end
+            got0.extend(it0)
+            got1.extend(it1)
+            assert np.array_equal(
+                np.concatenate(got0), np.asarray(spec.rank_indices(0, 0)))
+            assert np.array_equal(
+                np.concatenate(got1), np.asarray(spec.rank_indices(0, 1)))
+        finally:
+            c0.close()
+            c1.close()
 
 
 # --------------------------------------------- loader ride-through + degraded
