@@ -28,11 +28,15 @@
 #                          + benchmarks/elastic_smoke.py — mid-epoch
 #                          resharding: barrier/first-batch latency, the
 #                          exactly-once union law asserted throughout
+#   * telemetry smoke      tests/test_telemetry.py (`-m telemetry`)
+#                          + benchmarks/telemetry_smoke.py — trace-ID
+#                          propagation / flight-dump suite, then the
+#                          traced-vs-untraced overhead-within-noise bar
 
 PY ?= python
 
 .PHONY: check test bench native dryrun service-smoke chaos-smoke \
-	elastic-smoke
+	elastic-smoke telemetry-smoke
 
 # the driver parses the LAST line of bench.py's combined output (round 3
 # lost its headline to the details line — BENCH_r03.json "parsed": null),
@@ -80,6 +84,13 @@ chaos-smoke:
 elastic-smoke:
 	$(PY) -m pytest tests/test_elastic_service.py -q -m elastic -ra
 	$(PY) benchmarks/elastic_smoke.py
+
+# observability gate (docs/OBSERVABILITY.md): trace propagation across
+# the hard paths (reshard refusal, degraded fallback, injected dispatch
+# fault -> flight dump), then tracing's overhead-within-noise assertion
+telemetry-smoke:
+	$(PY) -m pytest tests/test_telemetry.py -q -m telemetry -ra
+	$(PY) benchmarks/telemetry_smoke.py
 
 native:
 	$(MAKE) -C csrc

@@ -291,6 +291,20 @@ def main() -> None:
         except Exception as exc:
             details["elastic_error"] = repr(exc)[:200]
 
+    # detail tier: telemetry — traced-vs-untraced served epoch wall per
+    # step; tracing must disappear into the untraced arm's own noise
+    # (methodology in benchmarks/telemetry_smoke.py)
+    if not smoke:
+        try:
+            sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+            from benchmarks.telemetry_smoke import (
+                summarize as telemetry_summarize,
+            )
+
+            details["telemetry"] = telemetry_summarize()
+        except Exception as exc:
+            details["telemetry_error"] = repr(exc)[:200]
+
     print(json.dumps(details), file=sys.stderr, flush=True)
     if not metric_printed:
         raise SystemExit("no backend produced a timing")

@@ -31,6 +31,10 @@ Public surface
   epoch state for a ``PartialShuffleSpec`` and streams per-rank index
   batches to N ``ServiceIndexClient`` loader processes over loopback TCP
   (backpressure, reconnect/resume, snapshots, metrics — docs/SERVICE.md).
+* ``telemetry`` — end-to-end host tracing for the served-index stack:
+  span tracer threaded through the service protocol, bounded flight
+  recorder with failure-triggered dumps, Prometheus/JSONL exporters;
+  off by default and zero-cost while off (docs/OBSERVABILITY.md).
 * ``enable_big_index_space()`` — opt into >=2^31-sample index spaces (x64).
 
 The normative permutation law lives in ``SPEC.md`` at the repo root.
@@ -60,7 +64,8 @@ def enable_big_index_space() -> None:
 
 def __getattr__(name):
     # Lazy subpackage access (torch / jax only imported when actually used).
-    if name in ("sampler", "parallel", "models", "utils", "service"):
+    if name in ("sampler", "parallel", "models", "utils", "service",
+                "telemetry"):
         import importlib
 
         return importlib.import_module(f".{name}", __name__)

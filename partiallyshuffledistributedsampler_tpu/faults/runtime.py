@@ -68,11 +68,23 @@ def active() -> Optional[FaultPlan]:
 
 def draw(site: str) -> Optional[FaultRule]:
     """Count one hit at ``site`` against the active plan; the cheap
-    no-plan fast path every instrumented call goes through."""
+    no-plan fast path every instrumented call goes through.
+
+    A rule that fires is a flight-recorder dump trigger
+    (docs/OBSERVABILITY.md): the event + dump land BEFORE the fault's
+    effect is applied, so the dump shows the spans that were open when
+    the fault hit.  Both are no-ops unless telemetry is enabled."""
     plan = active()
     if plan is None:
         return None
-    return plan.draw(site)
+    rule = plan.draw(site)
+    if rule is not None:
+        # lazy import: the fault runtime stays importable standalone and
+        # pays nothing on the (plan-armed but not firing) path
+        from ..telemetry import auto_dump, event
+        event("fault_injected", site=rule.site, kind=rule.kind)
+        auto_dump(f"fault.{rule.site}", kind=rule.kind)
+    return rule
 
 
 def perform(rule: FaultRule) -> None:

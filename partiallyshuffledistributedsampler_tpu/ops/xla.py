@@ -18,6 +18,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from ..telemetry import span as _span
 from . import core
 
 
@@ -336,8 +337,11 @@ def elastic_indices_jax(
          int(epoch) & 0xFFFFFFFF, int(rank) & 0xFFFFFFFF],
         dtype=np.uint32,
     )
-    with jax.profiler.TraceAnnotation("psds_elastic_regen"):
-        return fn(sv)
+    # host span and device annotation share one name, so the service
+    # trace timeline and a jax.profiler capture line up on it
+    with _span("psds_elastic_regen", epoch=int(epoch), rank=int(rank)):
+        with jax.profiler.TraceAnnotation("psds_elastic_regen"):
+            return fn(sv)
 
 
 def stream_indices_at_jax(
@@ -450,5 +454,8 @@ def epoch_indices_jax(
     else:  # traced scalars: stack on device
         sv = jnp.stack([core.as_u32_scalar(jnp, v)
                         for v in (seed_lo, seed_hi, epoch, rank)])
-    with jax.profiler.TraceAnnotation("psds_epoch_regen"):
-        return fn(sv)
+    # host span and device annotation share one name (epoch/rank may be
+    # traced scalars here, so the span carries only the static shape)
+    with _span("psds_epoch_regen", n=int(n), world=int(world)):
+        with jax.profiler.TraceAnnotation("psds_epoch_regen"):
+            return fn(sv)

@@ -40,6 +40,7 @@ import numpy as np
 
 from .. import faults as F
 from ..ops import core, ensure_index_backend
+from ..telemetry import span as _span
 from ..utils.watchdog import StallError
 
 _SENTINEL = object()
@@ -415,33 +416,37 @@ class HostDataLoader:
         from ..service.client import ServiceUnavailable
 
         client = self.index_client
-        if self.degraded:
-            now = time.monotonic()
-            if now - self._last_probe < self.reattach_interval:
-                return self._local_indices(epoch)
-            self._last_probe = now
-            if not client.probe():
-                return self._local_indices(epoch)
-            self.degraded = False
-            client.metrics.inc("reattached", self.rank)
-        try:
-            return np.asarray(client.epoch_indices(epoch))
-        except ServiceUnavailable as exc:
-            if not self.degraded_fallback:
-                raise
-            warnings.warn(
-                f"index service unavailable ({exc}); serving epoch "
-                f"{epoch} from the local spec (bit-identical stream) and "
-                "probing for re-attach",
-                RuntimeWarning,
-                stacklevel=3,
-            )
-            client.metrics.inc("degraded_mode", self.rank)
-            self.degraded = True
-            self._last_probe = time.monotonic()
-            return self._local_indices(epoch)
+        with _span("loader.serve_epoch", epoch=int(epoch),
+                   rank=self.rank) as sp:
+            if self.degraded:
+                now = time.monotonic()
+                if now - self._last_probe < self.reattach_interval:
+                    return self._local_indices(epoch)
+                self._last_probe = now
+                if not client.probe():
+                    return self._local_indices(epoch)
+                self.degraded = False
+                client.metrics.inc("reattached", self.rank)
+                sp.event("reattached")
+            try:
+                return np.asarray(client.epoch_indices(epoch))
+            except ServiceUnavailable as exc:
+                if not self.degraded_fallback:
+                    raise
+                warnings.warn(
+                    f"index service unavailable ({exc}); serving epoch "
+                    f"{epoch} from the local spec (bit-identical stream) "
+                    "and probing for re-attach",
+                    RuntimeWarning,
+                    stacklevel=3,
+                )
+                sp.event("degraded_fallback", error=str(exc))
+                client.metrics.inc("degraded_mode", self.rank)
+                self.degraded = True
+                self._last_probe = time.monotonic()
+                return self._local_indices(epoch, after=exc)
 
-    def _local_indices(self, epoch: int) -> np.ndarray:
+    def _local_indices(self, epoch: int, *, after=None) -> np.ndarray:
         """Degraded-mode regen: evaluate the loader's own spec.  Safe to
         substitute for the served stream because the WELCOME handshake
         already proved the daemon serves a spec with this (world-stripped
@@ -451,7 +456,12 @@ class HostDataLoader:
         composed from its adopted membership — the snapshotted §6 cascade
         chain, orphan descriptors, and delivery trail — via
         ``client.local_epoch_indices``; a stale base-spec regen would
-        serve the wrong partition of the remainder."""
+        serve the wrong partition of the remainder.
+
+        ``after`` is the exception that forced this fallback (if any);
+        when it crossed a traced RPC, its span tag links the degraded
+        regen span to the exact RPC that failed
+        (docs/OBSERVABILITY.md)."""
         client = self.index_client
         wire = getattr(client, "spec_wire", None)
         if wire is not None:
@@ -466,12 +476,17 @@ class HostDataLoader:
                     f"cannot degrade to local regen: daemon spec "
                     f"fingerprint {served} != local {ours}"
                 )
-        F.fire("loader.regen")
-        if client is not None and getattr(client, "generation", 0) > 0:
+        link = getattr(after, "_psds_span", None)
+        attrs = {"failed_rpc": list(link)} if link else {}
+        with _span("loader.degraded_regen", epoch=int(epoch),
+                   rank=self.rank, **attrs):
+            F.fire("loader.regen")
+            if client is not None and getattr(client, "generation", 0) > 0:
+                return np.asarray(
+                    client.local_epoch_indices(self.stream_spec, epoch)
+                )
             return np.asarray(
-                client.local_epoch_indices(self.stream_spec, epoch)
-            )
-        return np.asarray(self.stream_spec.rank_indices(epoch, self.rank))
+                self.stream_spec.rank_indices(epoch, self.rank))
 
     # -------------------------------------------------------------- gather
     def _gather(self, sl: np.ndarray) -> dict:

@@ -40,6 +40,7 @@ from typing import Iterator, Optional
 import numpy as np
 
 from .. import faults as F
+from ..telemetry import span as _span
 from ..utils.retry import RetryPolicy
 from . import protocol as P
 from .metrics import ServiceMetrics
@@ -291,7 +292,26 @@ class ServiceIndexClient:
         ``throttle``/``draining`` reply sleeps at least the
         server-suggested interval.  The policy's circuit breaker makes a
         freshly-exhausted dependency fail fast at the *next* operation's
-        entry instead of burning its full deadline again."""
+        entry instead of burning its full deadline again.
+
+        One telemetry span covers the whole operation — retries included —
+        so its ``trace`` context, stamped into the request header, is by
+        construction the same across every attempt of one logical request
+        (docs/OBSERVABILITY.md).  The ``rpc_ms`` histogram observes the
+        operation wall time whether or not tracing is on."""
+        t0 = time.perf_counter()
+        with _span("client.rpc", msg=P.msg_name(msg_type),
+                   rank=self.rank) as sp:
+            ctx = sp.ids
+            if ctx is not None:
+                header["trace"] = ctx
+            try:
+                return self._rpc_attempts(msg_type, header)
+            finally:
+                self.metrics.registry.histogram("rpc_ms").observe(
+                    (time.perf_counter() - t0) * 1e3)
+
+    def _rpc_attempts(self, msg_type: int, header: dict):
         pol = self.retry_policy
         if not pol.allow():
             raise ServiceUnavailable(
@@ -509,6 +529,15 @@ class ServiceIndexClient:
     def server_metrics(self) -> dict:
         _, header, _ = self._rpc(P.MSG_METRICS, {})
         return header["report"]
+
+    def trace_dump(self, limit: int = 256) -> dict:
+        """Pull the server's recent telemetry — the flight-recorder ring
+        plus its open spans (docs/OBSERVABILITY.md).  Returns the
+        TRACE_REPORT header: ``{"enabled": bool, "entries": [...]}``.
+        ``entries`` is empty (not an error) when the server runs with
+        tracing off."""
+        _, header, _ = self._rpc(P.MSG_TRACE_DUMP, {"limit": int(limit)})
+        return header
 
     # ------------------------------------------------------------- elastic
     def leave(self, grace_ms: Optional[int] = None) -> dict:

@@ -29,9 +29,13 @@ Client-side additions with the same vocabulary: ``reshards_ridden``
 a draining barrier), ``membership_lost`` (rejoin found no free rank).
 
 Per-client copies of the counters live under ``clients[rank]``; the
-registry holds the totals.  The epoch regen timer is the same
-:class:`RegenTimer` every sampler uses, so "epoch regen ms" means the
-same thing here as in a local training loop.
+registry holds the totals.  Per-client entries are pruned when the rank
+departs for good — lease eviction or a reshard commit that removes the
+rank — and their totals are folded into one aggregate ``departed``
+entry, so a long-lived daemon's report does not grow with every rank
+that ever connected (docs/OBSERVABILITY.md).  The epoch regen timer is
+the same :class:`RegenTimer` every sampler uses, so "epoch regen ms"
+means the same thing here as in a local training loop.
 """
 
 from __future__ import annotations
@@ -57,6 +61,7 @@ class ServiceMetrics:
         self.registry = registry if registry is not None else MetricsRegistry()
         self._lock = threading.Lock()
         self.clients: dict[int, dict[str, int]] = {}
+        self.departed: dict[str, int] = {}
 
     def inc(self, name: str, rank: int | None = None, value: int = 1) -> None:
         self.registry.inc(name, value)
@@ -66,6 +71,22 @@ class ServiceMetrics:
                     int(rank), {k: 0 for k in _PER_CLIENT}
                 )
                 per[name] += value
+
+    def drop_client(self, rank: int) -> bool:
+        """Prune rank's per-client entry, folding its counts into the
+        aggregate ``departed`` entry.  Called at lease eviction and at a
+        reshard commit that removes the rank; a later reconnect under the
+        same rank number starts a fresh entry.  Returns True if an entry
+        was dropped."""
+        with self._lock:
+            per = self.clients.pop(int(rank), None)
+            if per is None:
+                return False
+            self.departed["clients"] = self.departed.get("clients", 0) + 1
+            for name, v in per.items():
+                if v:
+                    self.departed[name] = self.departed.get(name, 0) + v
+            return True
 
     @property
     def regen_timer(self):
@@ -77,4 +98,6 @@ class ServiceMetrics:
             out["clients"] = {
                 str(r): dict(c) for r, c in sorted(self.clients.items())
             }
+            if self.departed:
+                out["departed"] = dict(self.departed)
         return out

@@ -37,11 +37,21 @@ Request → reply pairs (client sends left, server answers right):
     LEAVE      → OK | ERROR          preemption-notice drain: trigger a
                                      reshard to world-1 and drain out
     RESHARD    → OK | ERROR          explicit mid-epoch world change
+    TRACE_DUMP → TRACE_REPORT        recent telemetry entries (the
+                                     flight-recorder ring, bounded by
+                                     ``limit``; docs/OBSERVABILITY.md)
 
 Elastic error codes (docs/RESILIENCE.md "Elastic membership"):
 ``reshard`` (barrier in progress — retry shortly), ``resharded`` (the
 request named a stale generation; the header carries the new
 ``generation``/``world``/``layers`` membership to adopt).
+
+Tracing: any request header MAY carry ``trace=[trace_id, span_id]`` —
+the sender's open span context (docs/OBSERVABILITY.md).  Receivers that
+know about it parent their dispatch span under it; receivers that don't
+ignore it like any unknown header field, so the field rides inside
+protocol version 2 without a bump.  A disabled tracer never adds the
+field, so tracing-off peers put zero extra bytes on the wire.
 """
 
 from __future__ import annotations
@@ -57,6 +67,8 @@ from .. import faults as F
 
 #: bump on any framing/semantics change; HELLO negotiates it.
 #: v2: LEAVE/RESHARD messages, generation-stamped GET_BATCH, snapshot v2.
+#: Additive-within-v2 (no bump needed): TRACE_DUMP/TRACE_REPORT message
+#: types and the optional ``trace`` request-header field.
 PROTOCOL_VERSION = 2
 
 #: frames above this are a protocol violation (a corrupt length prefix
@@ -77,6 +89,8 @@ MSG_METRICS = 11
 MSG_METRICS_REPORT = 12
 MSG_LEAVE = 13
 MSG_RESHARD = 14
+MSG_TRACE_DUMP = 15
+MSG_TRACE_REPORT = 16
 
 _NAMES = {
     v: k[len("MSG_"):] for k, v in list(globals().items())

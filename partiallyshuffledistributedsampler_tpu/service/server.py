@@ -67,6 +67,8 @@ from typing import Optional
 import numpy as np
 
 from .. import faults as F
+from .. import telemetry
+from ..telemetry import annotate as _annotate, span as _span
 from ..utils.checkpoint import load_sampler_state, save_sampler_state
 from . import protocol as P
 from .metrics import ServiceMetrics
@@ -410,14 +412,21 @@ class IndexServer:
             if arr is not None:
                 self._cache.move_to_end(key)
                 return arr
-            with self.metrics.regen_timer.measure():
-                arr = np.asarray(spec.rank_indices(epoch, rank,
-                                                   layers=layers))
-                if orphans:
-                    # dead ranks' un-drained allocations ride as a prefix
-                    # of rank 0's stream — every index still served once
-                    parts = [self._orphan_slice(spec, o) for o in orphans]
-                    arr = np.concatenate(parts + [arr])
+            t0 = time.perf_counter()
+            with _span("server.epoch_regen", epoch=int(epoch),
+                       rank=int(rank), generation=gen):
+                with self.metrics.regen_timer.measure():
+                    arr = np.asarray(spec.rank_indices(epoch, rank,
+                                                       layers=layers))
+                    if orphans:
+                        # dead ranks' un-drained allocations ride as a
+                        # prefix of rank 0's stream — every index still
+                        # served once
+                        parts = [self._orphan_slice(spec, o)
+                                 for o in orphans]
+                        arr = np.concatenate(parts + [arr])
+            self.metrics.registry.histogram("epoch_regen_ms").observe(
+                (time.perf_counter() - t0) * 1e3)
             arr.setflags(write=False)
             self._cache[key] = arr
             while len(self._cache) > self._max_cached:
@@ -467,6 +476,10 @@ class IndexServer:
                     lease["owner"] = None
                     self._vacated.setdefault(rank, now)
                     self.metrics.inc("evictions", rank)
+                    # eviction ends the rank's tenure: archive its
+                    # per-client counters (AFTER counting the eviction,
+                    # so the archive includes it)
+                    self.metrics.drop_client(rank)
                     sock = self._conn_socks.get(owner)
                     if sock is not None:
                         to_close.append(sock)
@@ -546,11 +559,22 @@ class IndexServer:
                     except OSError:
                         pass
                     return
+                t0 = time.perf_counter()
                 try:
-                    F.fire("server.dispatch")
-                    self._dispatch(sock, conn_id, msg, header, payload)
+                    # the span wraps the fault-injection point too, so a
+                    # dump triggered by an injected dispatch fault shows
+                    # the request being served when it fired
+                    with _span("server." + P.msg_name(msg),
+                               trace=header.get("trace"), conn=conn_id,
+                               rank=header.get("rank")):
+                        F.fire("server.dispatch")
+                        self._dispatch(sock, conn_id, msg, header, payload)
                 except OSError:
                     return  # peer vanished mid-reply
+                if msg == P.MSG_GET_BATCH:
+                    self.metrics.registry.histogram(
+                        "batch_service_ms"
+                    ).observe((time.perf_counter() - t0) * 1e3)
         except (ConnectionError, OSError):
             return
         except F.InjectedThreadDeath:
@@ -613,6 +637,12 @@ class IndexServer:
             self._on_leave(sock, conn_id, header)
         elif msg == P.MSG_RESHARD:
             self._on_reshard(sock, conn_id, header)
+        elif msg == P.MSG_TRACE_DUMP:
+            limit = int(header.get("limit", 256))
+            P.send_msg(sock, P.MSG_TRACE_REPORT, {
+                "enabled": telemetry.enabled(),
+                "entries": telemetry.snapshot(limit),
+            })
         else:
             P.send_msg(sock, P.MSG_ERROR, {
                 "code": "unknown_type",
@@ -694,6 +724,7 @@ class IndexServer:
         target_world = int(target_world)
         if target_world < 1:
             raise ValueError(f"target_world must be >= 1, got {target_world}")
+        t_freeze = time.perf_counter()
         with self._lock:
             if self._reshard is not None or self._draining.is_set():
                 return False
@@ -766,13 +797,19 @@ class IndexServer:
                     leaving=dict(leaving or {}),
                     dead=set(dead or ()),
                 )
+                rs["t_drain"] = time.perf_counter()
                 self.metrics.inc("reshard_triggers")
+            self.metrics.registry.histogram("barrier_freeze_ms").observe(
+                (rs["t_drain"] - t_freeze) * 1e3)
+            telemetry.event("reshard_drain", target_world=target_world,
+                            barrier_units=int(barrier))
         except BaseException:
             # any failure between the freeze and the drain flip (shard
             # regen, target computation) must unfreeze, or every future
             # GET_BATCH draws an endless retry and the server is bricked
             with self._lock:
                 self._reshard = None
+            telemetry.auto_dump("reshard_abort")
             raise
         with self._lock:
             try:
@@ -861,6 +898,19 @@ class IndexServer:
             self.metrics.inc("orphaned", value=sum(
                 int(o["hi"]) - int(o["lo"]) for o in new_orphans))
         self.metrics.inc("reshards")
+        # departed ranks' per-client counters end their tenure here: a
+        # rank beyond the new world, or one that left/died at this
+        # barrier, is archived so the report doesn't grow forever
+        for r in range(old_world):
+            if (r >= self.spec.world or r in rs["leaving"]
+                    or r in rs["dead"]):
+                self.metrics.drop_client(r)
+        t_drain = rs.get("t_drain")
+        if t_drain is not None:  # absent on a restored (snapshot) barrier
+            self.metrics.registry.histogram("barrier_drain_ms").observe(
+                (time.perf_counter() - t_drain) * 1e3)
+        telemetry.event("reshard_commit", generation=self.generation,
+                        world=self.spec.world)
         return True
 
     def _on_leave(self, sock, conn_id, header) -> None:
@@ -1051,6 +1101,7 @@ class IndexServer:
                     continue  # genuinely live
                 lease["owner"] = None
                 self.metrics.inc("evictions", rank)
+                self.metrics.drop_client(rank)
             if fresh:
                 cur = self._cursors.get(rank)
                 if cur is not None and int(cur.get("samples", 0)) > 0:
@@ -1088,12 +1139,14 @@ class IndexServer:
             if gen != self.generation:
                 # the request names a stream of a committed-away
                 # generation: hand the client the membership to adopt
+                _annotate(error_code="resharded")
                 P.send_msg(sock, P.MSG_ERROR, self._resharded_err_locked(
                     f"generation {gen} was resharded away (now at "
                     f"{self.generation})"))
                 return
             rs = self._reshard
             if rs is not None and rs.get("phase") == "freeze":
+                _annotate(error_code="reshard")
                 P.send_msg(sock, P.MSG_ERROR, {
                     "code": "reshard", "retry_ms": 20,
                     "detail": "reshard barrier is freezing; retry shortly",
@@ -1118,6 +1171,7 @@ class IndexServer:
                 cur["acked"] = max(cur["acked"], int(ack))
             if seq > cur["acked"] + self.max_inflight:
                 self.metrics.inc("throttled", rank)
+                _annotate(error_code="throttle")
                 P.send_msg(sock, P.MSG_ERROR, {
                     "code": "throttle",
                     "detail": f"seq {seq} is {seq - cur['acked']} past the "

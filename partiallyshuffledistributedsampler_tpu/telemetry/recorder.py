@@ -1,0 +1,119 @@
+"""Flight recorder: a bounded ring of recent spans/events + JSONL dumps.
+
+The recorder is the black box of the served-index stack.  Every finished
+span and structured event lands in a lock-protected ``deque(maxlen=…)``;
+when something goes wrong — a fault injection fires, the prefetch
+watchdog raises ``StallError``, a reshard barrier aborts — the ring (plus
+every still-open span) is written out as one JSONL file so the failure
+comes with a reconstructable timeline instead of a bare counter bump.
+
+Entries are already redacted at record time (see ``trace._scrub``): ids,
+names, small attributes and durations only — never index payloads.
+Dumps are rate-limited by ``max_dumps`` per recorder lifetime so a fault
+storm cannot fill a disk.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+
+class FlightRecorder:
+    """Bounded in-memory ring of telemetry entries with JSONL dumps.
+
+    ``capacity`` bounds the ring (oldest entries fall off); ``dump_dir``
+    is where automatic dumps are written (``None`` disables them);
+    ``max_dumps`` caps files written per recorder lifetime; ``sink`` is
+    an optional live exporter (e.g. :class:`~.export.JsonlSink`) that
+    receives every entry as it is recorded."""
+
+    def __init__(self, capacity: int = 1024, dump_dir: Optional[str] = None,
+                 max_dumps: int = 16, sink=None) -> None:
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=max(1, int(capacity)))
+        self.dump_dir = dump_dir
+        self.max_dumps = int(max_dumps)
+        self.sink = sink
+        self._dump_seq = 0
+        self.dropped = 0  # entries pushed out of the ring
+
+    # ------------------------------------------------------------ recording
+    def record(self, entry: dict) -> None:
+        with self._lock:
+            if len(self._ring) == self._ring.maxlen:
+                self.dropped += 1
+            self._ring.append(entry)
+        sink = self.sink
+        if sink is not None:
+            try:
+                sink.write(entry)
+            except Exception:
+                pass  # a broken exporter must never take down the data path
+
+    def snapshot(self, limit: Optional[int] = None) -> list[dict]:
+        """Most-recent-last copy of the ring (optionally the last
+        ``limit`` entries) — what the TRACE_DUMP RPC returns."""
+        with self._lock:
+            out = list(self._ring)
+        if limit is not None and limit > 0:
+            out = out[-int(limit):]
+        return out
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self.dropped = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    # -------------------------------------------------------------- dumping
+    def dump(self, path: str, *, reason: str = "manual",
+             extra_entries=()) -> str:
+        """Write the ring + ``extra_entries`` (typically open spans) to
+        ``path`` as JSONL.  First line is a metadata record."""
+        entries = self.snapshot()
+        extra_entries = list(extra_entries)
+        with self._lock:
+            self._dump_seq += 1
+            seq = self._dump_seq
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.write(json.dumps({
+                "kind": "flight_dump", "reason": str(reason), "seq": seq,
+                "wall": round(time.time(), 3), "entries": len(entries),
+                "open_spans": len(extra_entries), "dropped": self.dropped,
+            }, separators=(",", ":")) + "\n")
+            for e in entries:
+                f.write(json.dumps(e, separators=(",", ":"),
+                                   default=repr) + "\n")
+            for e in extra_entries:
+                f.write(json.dumps(e, separators=(",", ":"),
+                                   default=repr) + "\n")
+        os.replace(tmp, path)  # dumps appear atomically, never half-written
+        return path
+
+    def auto_dump(self, reason: str, extra_entries=()) -> Optional[str]:
+        """Dump into ``dump_dir`` if configured and under the
+        ``max_dumps`` budget; returns the path or ``None``."""
+        d = self.dump_dir
+        if d is None:
+            return None
+        with self._lock:
+            if self._dump_seq >= self.max_dumps:
+                return None
+        os.makedirs(d, exist_ok=True)
+        slug = re.sub(r"[^A-Za-z0-9._-]+", "_", str(reason))[:64] or "dump"
+        name = f"flight-{int(time.time() * 1e3):013d}-{slug}.jsonl"
+        try:
+            return self.dump(os.path.join(d, name), reason=reason,
+                             extra_entries=extra_entries)
+        except OSError:
+            return None  # a full/readonly disk must not break the data path

@@ -9,9 +9,57 @@ here, so its smoke gate, ``bench.py`` and an operator poll read one report).
 
 from __future__ import annotations
 
+import bisect
+import math
 import threading
 import time
+from collections import deque
 from contextlib import contextmanager
+
+
+class _SampleRing:
+    """Fixed-size tail of samples with exact running totals.
+
+    Keeps the list surface existing consumers rely on (``len``, ``bool``,
+    iteration, negative indexing, ``clear()``) while bounding memory: the
+    deque holds only the most recent ``cap`` samples, and ``total`` /
+    ``count`` accumulate across everything ever appended so means stay
+    exact after old samples fall off.  ``clear()`` resets the totals too
+    (benchmark warmup resets depend on that)."""
+
+    __slots__ = ("_d", "total", "count")
+
+    def __init__(self, cap: int) -> None:
+        self._d: deque = deque(maxlen=max(1, int(cap)))
+        self.total = 0.0
+        self.count = 0
+
+    def append(self, v: float) -> None:
+        v = float(v)
+        self._d.append(v)
+        self.total += v
+        self.count += 1
+
+    def clear(self) -> None:
+        self._d.clear()
+        self.total = 0.0
+        self.count = 0
+
+    def __len__(self) -> int:
+        return len(self._d)
+
+    def __bool__(self) -> bool:
+        return bool(self._d)
+
+    def __getitem__(self, i):
+        return self._d[i]
+
+    def __iter__(self):
+        return iter(self._d)
+
+    def __repr__(self) -> str:
+        return (f"_SampleRing(cap={self._d.maxlen}, kept={len(self._d)}, "
+                f"count={self.count})")
 
 
 class RegenTimer:
@@ -21,10 +69,14 @@ class RegenTimer:
         with timer.measure():
             idx = epoch_indices_jax(...); idx.block_until_ready()
         timer.last_ms, timer.mean_ms, timer.count
-    """
 
-    def __init__(self) -> None:
-        self.samples_ms: list[float] = []
+    ``samples_ms`` is a bounded ring (default 1024 entries): a
+    long-running daemon timing one regen per epoch×rank keeps only the
+    recent tail, while ``count``/``mean_ms`` stay exact via running
+    totals maintained by the ring itself."""
+
+    def __init__(self, max_samples: int = 1024) -> None:
+        self.samples_ms = _SampleRing(max_samples)
 
     @contextmanager
     def measure(self):
@@ -36,7 +88,7 @@ class RegenTimer:
 
     @property
     def count(self) -> int:
-        return len(self.samples_ms)
+        return self.samples_ms.count
 
     @property
     def last_ms(self) -> float:
@@ -44,7 +96,8 @@ class RegenTimer:
 
     @property
     def mean_ms(self) -> float:
-        return sum(self.samples_ms) / len(self.samples_ms) if self.samples_ms else 0.0
+        ring = self.samples_ms
+        return ring.total / ring.count if ring.count else 0.0
 
     def report(self) -> dict:
         return {
@@ -54,17 +107,118 @@ class RegenTimer:
         }
 
 
+#: default histogram bounds: log-spaced ×2 from 1 µs up to ~35 minutes
+#: (in ms) — covers a fast loopback RPC through a pathological barrier
+_DEFAULT_BOUNDS = tuple(0.001 * 2 ** k for k in range(32))
+
+
+class Histogram:
+    """Fixed log-spaced latency buckets with exact count/sum.
+
+        h = Histogram()
+        h.observe(rpc_ms)
+        h.report()  # {"count", "mean_ms", "p50_ms", "p95_ms", "p99_ms", ...}
+
+    Buckets are upper bounds in milliseconds (default ×2 log-spaced from
+    1 µs to ~35 min); one overflow bucket catches the rest.  Percentiles
+    are linearly interpolated inside the winning bucket, clamped to the
+    observed min/max, so a handful of samples still report sane numbers.
+    Thread-safe; ``observe`` is a bisect + two adds under a lock."""
+
+    __slots__ = ("_lock", "bounds", "_counts", "_sum", "_count",
+                 "_min", "_max")
+
+    def __init__(self, bounds=None) -> None:
+        self._lock = threading.Lock()
+        self.bounds = tuple(float(b) for b in (bounds or _DEFAULT_BOUNDS))
+        if list(self.bounds) != sorted(self.bounds):
+            raise ValueError("histogram bounds must be sorted ascending")
+        self._counts = [0] * (len(self.bounds) + 1)  # +1 = overflow
+        self._sum = 0.0
+        self._count = 0
+        self._min = math.inf
+        self._max = -math.inf
+
+    def observe(self, value_ms: float) -> None:
+        v = float(value_ms)
+        i = bisect.bisect_left(self.bounds, v)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += v
+            self._count += 1
+            if v < self._min:
+                self._min = v
+            if v > self._max:
+                self._max = v
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    def percentile(self, q: float) -> float:
+        """Interpolated q-quantile (q in [0, 1]) from the bucket counts."""
+        with self._lock:
+            return self._percentile_locked(q)
+
+    def _percentile_locked(self, q: float) -> float:
+        if self._count == 0:
+            return 0.0
+        target = q * self._count
+        cum = 0
+        for i, c in enumerate(self._counts):
+            if c == 0:
+                continue
+            if cum + c >= target:
+                lo = self.bounds[i - 1] if i > 0 else 0.0
+                hi = self.bounds[i] if i < len(self.bounds) else self._max
+                frac = (target - cum) / c
+                est = lo + (hi - lo) * max(0.0, min(1.0, frac))
+                return max(self._min, min(self._max, est))
+            cum += c
+        return self._max
+
+    def state(self) -> dict:
+        """Raw bucket state for exporters (per-bucket, not cumulative)."""
+        with self._lock:
+            return {
+                "bounds": list(self.bounds),
+                "counts": list(self._counts[:-1]),
+                "overflow": self._counts[-1],
+                "sum": self._sum,
+                "count": self._count,
+            }
+
+    def report(self) -> dict:
+        with self._lock:
+            if self._count == 0:
+                return {"count": 0, "mean_ms": 0.0, "p50_ms": 0.0,
+                        "p95_ms": 0.0, "p99_ms": 0.0, "max_ms": 0.0}
+            return {
+                "count": self._count,
+                "mean_ms": round(self._sum / self._count, 3),
+                "p50_ms": round(self._percentile_locked(0.50), 3),
+                "p95_ms": round(self._percentile_locked(0.95), 3),
+                "p99_ms": round(self._percentile_locked(0.99), 3),
+                "max_ms": round(self._max, 3),
+            }
+
+
 class MetricsRegistry:
-    """Thread-safe named counters + latency timers under one report.
+    """Thread-safe named counters + latency timers + histograms under one
+    report.
 
         reg = MetricsRegistry()
         reg.inc("batches_served")
         with reg.timer("epoch_regen_ms").measure():
             regenerate()
-        reg.report()  # {"counters": {...}, "timers": {name: {...}}}
+        reg.histogram("rpc_ms").observe(1.25)
+        reg.report()  # {"counters": {...}, "timers": {...}, "histograms": {...}}
 
     Counters are plain monotonically-increasing ints; timers are
-    :class:`RegenTimer` instances created on first use.  Every method is
+    :class:`RegenTimer` instances created on first use; histograms are
+    :class:`Histogram` instances created on first use (``rpc_ms``,
+    ``batch_service_ms``, ``barrier_freeze_ms``, ``barrier_drain_ms``,
+    ``epoch_regen_ms`` in the served-index stack).  Every method is
     safe from concurrent threads (the service daemon increments from one
     thread per connection)."""
 
@@ -72,6 +226,7 @@ class MetricsRegistry:
         self._lock = threading.Lock()
         self._counters: dict[str, int] = {}
         self._timers: dict[str, RegenTimer] = {}
+        self._histograms: dict[str, Histogram] = {}
 
     def inc(self, name: str, value: int = 1) -> int:
         with self._lock:
@@ -90,14 +245,31 @@ class MetricsRegistry:
                 t = self._timers[name] = RegenTimer()
             return t
 
+    def histogram(self, name: str, bounds=None) -> Histogram:
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None:
+                h = self._histograms[name] = Histogram(bounds)
+            return h
+
+    def histogram_states(self) -> dict:
+        """Raw bucket states keyed by name (exporter surface — see
+        ``telemetry.render_prometheus``)."""
+        with self._lock:
+            hs = dict(self._histograms)
+        return {k: h.state() for k, h in hs.items()}
+
     def reset(self) -> None:
         with self._lock:
             self._counters.clear()
             self._timers.clear()
+            self._histograms.clear()
 
     def report(self) -> dict:
         with self._lock:
             return {
                 "counters": dict(self._counters),
                 "timers": {k: t.report() for k, t in self._timers.items()},
+                "histograms": {k: h.report()
+                               for k, h in self._histograms.items()},
             }

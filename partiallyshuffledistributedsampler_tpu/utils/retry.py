@@ -29,6 +29,8 @@ import threading
 import time
 from typing import Optional
 
+from ..telemetry import current as _current_span
+
 
 class RetryPolicy:
     """Shared retry semantics + breaker state; one instance per dependency.
@@ -164,5 +166,11 @@ class RetryState:
         if self.deadline is not None \
                 and pol._clock() + delay > self.deadline:
             return False
+        sp = _current_span()
+        if sp is not None:
+            # the retry timeline rides the operation's span (no-op when
+            # tracing is off: current() is then always None)
+            sp.event("retry_pause", attempt=self.attempts,
+                     delay_ms=round(delay * 1e3, 3))
         pol._sleep(delay)
         return True
