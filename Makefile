@@ -32,11 +32,16 @@
 #                          + benchmarks/telemetry_smoke.py — trace-ID
 #                          propagation / flight-dump suite, then the
 #                          traced-vs-untraced overhead-within-noise bar
+#   * failover smoke       tests/test_failover.py (`-m failover`)
+#                          + benchmarks/failover_smoke.py — hot-standby
+#                          replication: kill-mid-epoch bit-identity and
+#                          zombie fencing, then the failover-stall +
+#                          shipping-overhead-within-noise bar
 
 PY ?= python
 
 .PHONY: check test bench native dryrun service-smoke chaos-smoke \
-	elastic-smoke telemetry-smoke
+	elastic-smoke telemetry-smoke failover-smoke
 
 # the driver parses the LAST line of bench.py's combined output (round 3
 # lost its headline to the details line — BENCH_r03.json "parsed": null),
@@ -84,6 +89,13 @@ chaos-smoke:
 elastic-smoke:
 	$(PY) -m pytest tests/test_elastic_service.py -q -m elastic -ra
 	$(PY) benchmarks/elastic_smoke.py
+
+# replication gate (docs/RESILIENCE.md "Replication & failover"): the
+# hot-standby suite (kill-mid-epoch bit-identity, drain-boundary union
+# law, zombie fencing), then the failover-latency + overhead smoke
+failover-smoke:
+	$(PY) -m pytest tests/test_failover.py -q -m failover -ra
+	$(PY) benchmarks/failover_smoke.py
 
 # observability gate (docs/OBSERVABILITY.md): trace propagation across
 # the hard paths (reshard refusal, degraded fallback, injected dispatch

@@ -305,6 +305,20 @@ def main() -> None:
         except Exception as exc:
             details["telemetry_error"] = repr(exc)[:200]
 
+    # detail tier: failover — client-observed stall across a primary
+    # kill + steady-state WAL-shipping overhead vs the unreplicated arm
+    # (methodology in benchmarks/failover_smoke.py)
+    if not smoke:
+        try:
+            sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+            from benchmarks.failover_smoke import (
+                summarize as failover_summarize,
+            )
+
+            details["failover"] = failover_summarize()
+        except Exception as exc:
+            details["failover_error"] = repr(exc)[:200]
+
     print(json.dumps(details), file=sys.stderr, flush=True)
     if not metric_printed:
         raise SystemExit("no backend produced a timing")

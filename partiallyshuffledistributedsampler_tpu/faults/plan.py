@@ -22,6 +22,9 @@ SITES = frozenset({
     "server.dispatch",       # one request on a daemon serve thread
     "server.snapshot_write", # the daemon persisting its snapshot
     "server.reshard",        # a reshard barrier freezing / committing
+    "server.zombie_write",   # a fenced ex-primary refusing a client write
+    "repl.append",           # the primary appending a WAL record
+    "repl.promote",          # a standby promoting itself to primary
     "client.leave",          # a client announcing its preemption drain
     "loader.prefetch",       # one step of HostDataLoader's gather thread
     "loader.regen",          # local epoch index generation

@@ -406,14 +406,17 @@ class HostDataLoader:
     def _served_indices(self, epoch: int) -> np.ndarray:
         """The service path with graceful degradation (docs/RESILIENCE.md).
 
-        Healthy: fetch the epoch stream from the daemon.  If the daemon
-        stays down past the client's ``reconnect_timeout`` and
+        Healthy: fetch the epoch stream from the daemon.  When the
+        daemon ships its WAL to a hot standby, a dead primary is handled
+        INSIDE the client (transparent failover — no degraded entry);
+        only if every peer stays down past the client's
+        ``reconnect_timeout`` and
         ``degraded_fallback`` is on, compute the stream locally from the
         same :class:`~..service.spec.PartialShuffleSpec` — bit-identical
         by the fingerprint handshake — and keep training; while degraded,
         probe the daemon at most every ``reattach_interval`` seconds and
         re-attach when it answers."""
-        from ..service.client import ServiceUnavailable
+        from ..service.client import FencedError, ServiceUnavailable
 
         client = self.index_client
         with _span("loader.serve_epoch", epoch=int(epoch),
@@ -430,7 +433,10 @@ class HostDataLoader:
                 sp.event("reattached")
             try:
                 return np.asarray(client.epoch_indices(epoch))
-            except ServiceUnavailable as exc:
+            except (ServiceUnavailable, FencedError) as exc:
+                # FencedError means every reachable peer lost a promotion
+                # race and no serving primary is attached — operationally
+                # the same "both peers down" as ServiceUnavailable
                 if not self.degraded_fallback:
                     raise
                 warnings.warn(

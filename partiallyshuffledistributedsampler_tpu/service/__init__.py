@@ -8,10 +8,13 @@ and streams disjoint per-rank index ranges to N
 :class:`ServiceIndexClient` s over loopback TCP — with backpressure,
 rank leases, reconnect/resume, snapshots, metrics, and elastic
 membership (mid-epoch resharding with preemption-aware drain,
-docs/RESILIENCE.md "Elastic membership").
+docs/RESILIENCE.md "Elastic membership").  A primary/standby pair adds
+hot-standby replication: WAL shipping, transparent client failover, and
+split-brain fencing (docs/RESILIENCE.md "Replication & failover").
 """
 
 from .client import (  # noqa: F401
+    FencedError,
     ReshardInProgress,
     ServiceError,
     ServiceIndexClient,

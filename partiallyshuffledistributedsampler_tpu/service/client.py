@@ -29,6 +29,20 @@ exactly-once stream across the world change.  ``leave(grace_ms)`` is the
 preemption-notice drain (hook it to SIGTERM); while a barrier drains,
 requests wait it out through the retry policy and surface a typed
 :class:`ReshardInProgress` only when the deadline is exhausted.
+
+Hot-standby failover (docs/RESILIENCE.md "Replication & failover"): the
+WELCOME header carries the standby's address when the server ships its
+WAL to one.  When the primary's retry budget exhausts, the client fails
+over — re-HELLO to the standby with ``failover=true`` (which promotes it
+once its replication feed is stale) under a FRESH retry deadline and
+budget, then replays its delivered-ack cursor; the PR 3 ack machinery
+makes the resumed stream exactly-once and bit-identical.  The client
+adopts the fencing ``term`` from every WELCOME and stamps it on requests
+after a failover; a fenced zombie primary's typed ``fenced`` refusal
+(``serving=false``) routes the client to the winner, surfacing
+:class:`FencedError` only when no peer at the winning term is
+reachable.  A client pointed at a standby of a *healthy* pair follows
+the ``standby`` error's ``primary`` redirect instead.
 """
 
 from __future__ import annotations
@@ -83,6 +97,19 @@ class ReshardInProgress(ServiceError):
 
     def __init__(self, detail: str) -> None:
         super().__init__("reshard", detail)
+
+
+class FencedError(ServiceError):
+    """Every reachable peer refused the request as fenced: a promotion
+    to ``term`` superseded the server(s) this client can reach, and no
+    peer serving at that term is attached.  The stream is intact — a
+    retry once the new primary is reachable (or the degraded local
+    fallback) continues it exactly-once."""
+
+    def __init__(self, term: int, detail: str = "",
+                 header: Optional[dict] = None) -> None:
+        super().__init__("fenced", detail, header)
+        self.term = int(term)
 
 
 def _parse_address(address):
@@ -175,6 +202,16 @@ class ServiceIndexClient:
         self._epoch_samples = 0          # delivered watermark, current gen
         self._samples_epoch: Optional[int] = None
         self._leaving = False            # set by leave(): boundary = eof
+        # -------- hot-standby failover (docs/RESILIENCE.md) --------
+        #: the primary's standby, learned from WELCOME; the failover peer
+        self.standby_address: Optional[tuple] = None
+        #: highest fencing term seen; stamped on requests once > 0
+        self.term = 0
+        #: next HELLO asks the peer to promote (we are failing over)
+        self._promote_on_connect = False
+        #: perf_counter at failover start — observed into ``failover_ms``
+        #: at the first successful WELCOME after it
+        self._failover_t0: Optional[float] = None
 
     # ----------------------------------------------------------- connection
     def _connect(self) -> None:
@@ -186,6 +223,12 @@ class ServiceIndexClient:
             "rank": -1 if self.rank is None else self.rank,
             "batch": self.batch,
         }
+        if self.term > 0:
+            hello["term"] = self.term
+        if self._promote_on_connect:
+            # failing over: ask the standby to promote (it will, once its
+            # replication feed has been stale for repl_feed_timeout)
+            hello["failover"] = True
         if self.expected_spec is not None:
             # world-stripped: under elastic membership the server's world
             # drifts legitimately; only the stream-shaping config must match
@@ -209,8 +252,19 @@ class ServiceIndexClient:
         self.rank = int(header["rank"])
         self.spec_wire = header.get("spec")
         self.server_epoch = header.get("epoch")
+        sb = header.get("standby")
+        if sb is not None:
+            self.standby_address = _parse_address(sb)
+        t = header.get("term")
+        if t is not None:
+            self.term = max(self.term, int(t))
         self._adopt_membership(header)
         self._sock = sock
+        self._promote_on_connect = False
+        if self._failover_t0 is not None:
+            self.metrics.registry.histogram("failover_ms").observe(
+                (time.perf_counter() - self._failover_t0) * 1e3)
+            self._failover_t0 = None
 
     def _adopt_membership(self, header: dict) -> None:
         """Take on the membership a WELCOME or ``resharded`` error carries.
@@ -222,6 +276,11 @@ class ServiceIndexClient:
         if "generation" not in header:
             return
         gen = int(header["generation"])
+        if gen < self.generation:
+            # a behind peer (a standby promoted before the dead primary
+            # shipped its last commit): keep our newer membership — the
+            # stream loop flushes our acks so the peer catches up
+            return
         if gen > self.generation:
             if self.world is not None and self.rank is not None:
                 self._trail.append({
@@ -320,11 +379,23 @@ class ServiceIndexClient:
                 f"{pol.breaker_reset}s"
             )
         op = pol.begin()
+        # peers whose retry budget this operation already opened: the
+        # current one now, the standby if we fail over to it.  Failover
+        # is a per-PEER budget (``_begin_failover`` calls ``begin()``
+        # again) — the dead primary's exhausted deadline never bills the
+        # standby.
+        tried = {self.address}
         while True:
             try:
                 try:
                     self._ensure_connected()
                 except ServiceError as exc:
+                    if exc.code == "standby":
+                        op = self._on_standby(exc, op, tried)
+                        continue
+                    if exc.code == "fenced":
+                        op = self._on_fenced(exc.header, op, tried)
+                        continue
                     if exc.code not in ("rank_taken", "not_owner"):
                         raise
                     # our own just-dropped lease may not have been released
@@ -338,6 +409,10 @@ class ServiceIndexClient:
                     # what assigns auto-claimed ranks — stamp the current
                     # one on every attempt
                     header["rank"] = self.rank
+                if self.term > 0:
+                    # the fencing term rides every post-promotion request:
+                    # a zombie primary must refuse, not serve, it
+                    header["term"] = self.term
                 P.send_msg(self._sock, msg_type, header,
                            site="service.send")
                 reply, rheader, payload = P.recv_msg(self._sock,
@@ -348,10 +423,13 @@ class ServiceIndexClient:
                 self.metrics.inc("reconnects", self.rank)
                 pol.record_failure()
                 if not op.pause():
-                    raise ServiceUnavailable(
-                        f"no server at {self.address} after {op.attempts} "
-                        f"attempts ({exc!r})"
-                    ) from None
+                    peer = self._failover_peer(tried)
+                    if peer is None:
+                        raise ServiceUnavailable(
+                            f"no server at {self.address} after "
+                            f"{op.attempts} attempts ({exc!r})"
+                        ) from None
+                    op = self._begin_failover(peer, tried)
                 continue
             pol.record_success()
             if reply == P.MSG_ERROR:
@@ -393,8 +471,86 @@ class ServiceIndexClient:
                             "commit within the retry deadline"
                         )
                     continue
+                if code == "standby":
+                    # the peer demoted/never promoted under us
+                    self.close()
+                    op = self._on_standby(
+                        ServiceError(code, rheader.get("detail", ""),
+                                     rheader), op, tried)
+                    continue
+                if code == "fenced":
+                    self.close()
+                    op = self._on_fenced(rheader, op, tried)
+                    continue
                 raise ServiceError(code, rheader.get("detail", ""), rheader)
             return reply, rheader, payload
+
+    # ----------------------------------------------------------- failover
+    def _failover_peer(self, tried) -> Optional[tuple]:
+        """The peer this operation has not yet spent a budget on (the
+        standby learned at WELCOME), or None when every peer is spent —
+        the caller's signal that both peers are down."""
+        sb = self.standby_address
+        if sb is not None and sb not in tried:
+            return sb
+        return None
+
+    def _begin_failover(self, peer: tuple, tried: set):
+        """Point the client at ``peer`` under a FRESH retry deadline and
+        budget — the whole point of per-peer budgets: a standby must get
+        its full window, not the dead primary's leftovers."""
+        self.close()
+        self.address = peer
+        tried.add(peer)
+        self._promote_on_connect = True
+        if self._failover_t0 is None:
+            self._failover_t0 = time.perf_counter()
+        self.metrics.inc("failovers", self.rank)
+        # the new peer gets a clean breaker slate too: the consecutive
+        # failures that exhausted the old peer say nothing about this one
+        self.retry_policy.record_success()
+        return self.retry_policy.begin()
+
+    def _on_standby(self, exc: ServiceError, op, tried):
+        """The peer answered ``standby``.  A healthy pair redirects us to
+        its primary; mid-failover we keep knocking (the standby promotes
+        once its feed goes stale) until this peer's budget is spent."""
+        hdr = exc.header
+        t = hdr.get("term")
+        if t is not None:
+            self.term = max(self.term, int(t))
+        primary = hdr.get("primary")
+        if not self._promote_on_connect and primary is not None:
+            redirect = _parse_address(primary)
+            if redirect != self.address and redirect not in tried:
+                self.close()
+                self.address = redirect
+                tried.add(redirect)
+                return op
+        if not op.pause(min_delay=float(hdr.get("retry_ms", 100)) / 1e3):
+            peer = self._failover_peer(tried)
+            if peer is None:
+                raise exc
+            return self._begin_failover(peer, tried)
+        return op
+
+    def _on_fenced(self, hdr: dict, op, tried):
+        """The peer answered ``fenced``: a promotion happened.  Adopt the
+        winning term; when the refuser itself keeps serving at that term
+        (``serving=true`` — our stamp was merely stale) just retry it,
+        otherwise it is a zombie and we fail over to the winner."""
+        t = int(hdr.get("term", 0))
+        if t > self.term:
+            self.term = t
+        self.metrics.inc("fenced_replies", self.rank)
+        if hdr.get("serving"):
+            return op
+        peer = self._failover_peer(tried)
+        if peer is None:
+            raise FencedError(
+                t, f"every reachable peer is fenced below term {t} and "
+                   "no serving primary is attached", hdr)
+        return self._begin_failover(peer, tried)
 
     # ------------------------------------------------------------- batches
     def epoch_batches(self, epoch: int, *,
@@ -422,6 +578,7 @@ class ServiceIndexClient:
             self._samples_epoch = epoch
         rejects = 0
         gen = self.generation
+        behind_t0 = None
         while True:
             if self.generation != gen:
                 # a reconnect inside _rpc adopted a newer membership
@@ -444,11 +601,41 @@ class ServiceIndexClient:
                         return
                     # the world changed underneath us: adopt the carried
                     # membership and continue the stream under it
+                    prev_gen = self.generation
                     self._adopt_membership(exc.header)
+                    if self.generation == prev_gen:
+                        # a failover raced a commit the dead primary never
+                        # shipped: the promoted standby is still draining
+                        # the barrier we already rode through.  Flush our
+                        # pre-barrier delivered-ack watermark so its drain
+                        # can complete, then retry at the SAME cursor —
+                        # resetting seq here would double-serve.
+                        if behind_t0 is None:
+                            behind_t0 = time.monotonic()
+                        elif (time.monotonic() - behind_t0
+                                > self.reconnect_timeout):
+                            raise ReshardInProgress(
+                                f"peer at {self.address} stayed a "
+                                "generation behind past the reconnect "
+                                "deadline") from None
+                        self._flush_trail_ack(epoch)
+                        time.sleep(min(0.05, self.backoff_base))
+                        continue
+                    behind_t0 = None
                     if not (self.rank is not None and self.world is not None
                             and self.rank < self.world):
-                        # our rank no longer exists — auto-claim a freed
-                        # slot (typically the leaver's) on reconnect
+                        if not exc.header.get("vacated"):
+                            # shrunk out with no slot vacated for a
+                            # rejoin: the commit already drained (or
+                            # orphaned) our whole pre-barrier span, and
+                            # claiming a slot a survivor merely finished
+                            # and freed would re-serve its stream — seen
+                            # at failover, when the survivor finishes
+                            # before our reconnect budget sends us here
+                            self.metrics.inc("membership_lost")
+                            return
+                        # our rank no longer exists — auto-claim the
+                        # vacated slot (typically the leaver's)
                         self.close()
                         self.rank = None
                     gen, seq = self.generation, 0
@@ -521,6 +708,23 @@ class ServiceIndexClient:
             header["epoch"] = int(self._cursor["epoch"])
             header["ack"] = int(self._cursor["seq"]) - 1
         self._rpc(P.MSG_HEARTBEAT, header)
+
+    def _flush_trail_ack(self, epoch: int) -> None:
+        """Re-deliver the pre-barrier ack watermark (the trail's last
+        recorded delivery) to a generation-behind peer, so its inherited
+        drain gate — which commits on *acked* delivery — can complete
+        the barrier the dead primary never shipped the commit of."""
+        if not self._trail:
+            return
+        samples = int(self._trail[-1].get("samples", 0))
+        ack = -(-samples // self.batch) - 1  # ceil(samples/batch) - 1
+        if ack < 0:
+            return
+        try:
+            self._rpc(P.MSG_HEARTBEAT,
+                      {"rank": self.rank, "epoch": int(epoch), "ack": ack})
+        except ServiceError:
+            pass  # best-effort: the stream loop comes back around
 
     def snapshot(self) -> dict:
         _, header, _ = self._rpc(P.MSG_SNAPSHOT, {})

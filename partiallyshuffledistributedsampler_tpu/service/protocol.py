@@ -41,10 +41,34 @@ Request → reply pairs (client sends left, server answers right):
                                      flight-recorder ring, bounded by
                                      ``limit``; docs/OBSERVABILITY.md)
 
+Replication frames (docs/RESILIENCE.md "Replication & failover"; the
+primary's shipper sends left, the standby answers right):
+
+    REPL_SYNC    → OK | ERROR        bootstrap: term + lsn + the full
+                                     snapshot-v2 state dict
+    REPL_APPEND  → OK | ERROR        a run of sequenced WAL records
+                                     (``ERROR(code='repl_gap')`` asks
+                                     for a re-SYNC; ``fenced`` tells a
+                                     zombie primary it was superseded)
+    REPL_PROMOTE → OK | ERROR        promote the standby to primary
+                                     (refused ``standby`` while its
+                                     replication feed is still fresh,
+                                     unless ``force`` is set)
+
 Elastic error codes (docs/RESILIENCE.md "Elastic membership"):
 ``reshard`` (barrier in progress — retry shortly), ``resharded`` (the
 request named a stale generation; the header carries the new
 ``generation``/``world``/``layers`` membership to adopt).
+
+Replication error codes (docs/RESILIENCE.md "Replication & failover"):
+``standby`` (this server is a hot standby; the header carries the
+``primary`` address and the current ``term`` — data ops are refused
+until a promotion), ``fenced`` (the request's fencing term lost: the
+header carries the winning ``term`` and ``serving`` — True when THIS
+server keeps serving at that term and the caller should adopt it and
+retry, False when this server is a fenced zombie and the caller must
+fail over), ``repl_gap`` (an append's ``from_lsn`` does not extend the
+standby's applied prefix; the shipper re-SYNCs).
 
 Tracing: any request header MAY carry ``trace=[trace_id, span_id]`` —
 the sender's open span context (docs/OBSERVABILITY.md).  Receivers that
@@ -91,6 +115,10 @@ MSG_LEAVE = 13
 MSG_RESHARD = 14
 MSG_TRACE_DUMP = 15
 MSG_TRACE_REPORT = 16
+# additive-within-v2 (like TRACE_DUMP): hot-standby replication frames
+MSG_REPL_SYNC = 17
+MSG_REPL_APPEND = 18
+MSG_REPL_PROMOTE = 19
 
 _NAMES = {
     v: k[len("MSG_"):] for k, v in list(globals().items())

@@ -1,0 +1,284 @@
+"""Hot-standby replication: WAL shipping from a primary ``IndexServer``.
+
+The primary appends every state-mutating transition to a sequenced
+in-memory WAL (:class:`ReplicationLog`) and a background
+:class:`ReplicationShipper` streams it to a standby ``IndexServer``
+over the existing length-prefixed protocol (``REPL_SYNC`` /
+``REPL_APPEND`` frames, docs/RESILIENCE.md "Replication & failover").
+
+Design points:
+
+* **Serving never blocks on the standby.**  ``append`` is an in-memory
+  deque push under a lock; the shipper drains it asynchronously.  A
+  slow, dead, or never-attached standby costs the primary nothing but
+  the (bounded) log memory; the shipper reconnects with backoff and
+  re-bootstraps (``REPL_SYNC`` carries the full snapshot-v2 state) when
+  the tail it needs has been dropped.
+* **Record vocabulary.**  Cheap high-frequency transitions ship as
+  narrow records (``cursor`` upserts, ``lease`` grants/releases,
+  ``epoch`` sets); the rare complex transitions — a reshard barrier's
+  freeze→drain flip and its commit — ship the full state dict
+  (``state`` records), so the standby applies them with the same code
+  path a snapshot restore uses and cannot mis-replay a barrier.
+  ``seal`` marks a primary snapshot write, letting a standby with its
+  own ``snapshot_path`` persist at the same cadence.
+* **Fencing terms.**  Every frame carries the primary's ``term``.  A
+  promoted standby answers an old-term frame with
+  ``ERROR(code='fenced')`` carrying the winning term — the zombie
+  primary's shipper surfaces that through ``on_fenced`` and the server
+  fences itself (every subsequent client write refused, docs/
+  RESILIENCE.md "Split-brain fencing").
+* **Fault sites.**  ``repl.append`` fires on every WAL append; an
+  injected fault there degrades to a forced re-SYNC (counted as
+  ``repl_append_errors``) — replication is an availability feature and
+  must never take the serving path down.  ``repl.promote`` fires inside
+  the standby's promotion (server.py) before any state flips.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from collections import deque
+from typing import Callable, Optional
+
+from .. import faults as F
+from .. import telemetry
+from . import protocol as P
+
+#: how many WAL records the in-memory log retains; a standby that falls
+#: further behind is re-bootstrapped via REPL_SYNC instead of replaying
+LOG_TAIL = 4096
+
+#: idle shipper tick: also the empty-append heartbeat cadence the standby
+#: judges feed freshness by (repl_feed_timeout must comfortably exceed it)
+SHIP_TICK_S = 0.2
+
+
+class ReplicationLog:
+    """Sequenced, bounded, thread-safe WAL of state transitions.
+
+    Records are ``{"lsn": int, "op": str, **data}``; ``lsn`` is a dense
+    1-based sequence.  ``append`` is the ``repl.append`` fault site: an
+    injected failure marks the log for re-SYNC (the shipper re-ships the
+    full state) rather than surfacing into the serving path."""
+
+    def __init__(self, metrics=None, tail: int = LOG_TAIL) -> None:
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._records: deque = deque(maxlen=max(1, int(tail)))
+        self.lsn = 0               # last appended
+        self.resync_needed = False
+        self._urgent = False       # a non-absorbing record is pending
+        self._metrics = metrics
+
+    def append(self, op: str, data: dict) -> None:
+        try:
+            F.fire("repl.append")
+        except F.InjectedThreadDeath:
+            raise
+        except Exception:
+            # an append that failed mid-transition could leave the log
+            # with a hole; the recovery is a full re-SYNC, never an
+            # error on the serving path that caused the transition
+            with self._cond:
+                self.lsn += 1
+                self.resync_needed = True
+                self._urgent = True
+                self._cond.notify_all()
+            if self._metrics is not None:
+                self._metrics.inc("repl_append_errors")
+            return
+        with self._cond:
+            self.lsn += 1
+            rec = {"lsn": self.lsn, "op": op, **data}
+            self._records.append(rec)
+            # ``cursor`` upserts arrive once per served batch and are
+            # absorbing (a newer one supersedes an older one for the
+            # same rank), so they coalesce until the next ship tick
+            # instead of waking the shipper into a per-batch round trip
+            # — that synchronous chatter is what would otherwise make
+            # replication visible in the serving path's wall clock
+            if op != "cursor":
+                self._urgent = True
+                self._cond.notify_all()
+        if self._metrics is not None:
+            self._metrics.inc("repl_appends")
+
+    def take(self, after_lsn: int, timeout: float = SHIP_TICK_S):
+        """Records with ``lsn > after_lsn``, waiting up to ``timeout``
+        unless a non-absorbing record is already pending.  Superseded
+        ``cursor`` records (an older upsert for a rank that has a newer
+        one in the same batch) are thinned out; the standby's applied
+        cursor jumps over the thinned lsns, which its gap check allows
+        because the batch's boundary lsns stay intact.  Returns
+        ``(records, resync)``: ``resync`` True when the tail no longer
+        reaches back to ``after_lsn + 1`` (or an append failed) and the
+        shipper must re-bootstrap."""
+        with self._cond:
+            if not self._urgent and not self.resync_needed:
+                self._cond.wait(timeout)
+            self._urgent = False
+            if self.resync_needed:
+                return [], True
+            recs = [r for r in self._records if r["lsn"] > after_lsn]
+            if recs and recs[0]["lsn"] != after_lsn + 1:
+                return [], True  # tail rotated past the standby's cursor
+            if not recs and self.lsn > after_lsn:
+                return [], True  # everything newer was already dropped
+            newest_cursor = {
+                r["rank"]: r["lsn"] for r in recs if r["op"] == "cursor"}
+            return [r for r in recs
+                    if r["op"] != "cursor"
+                    or newest_cursor[r["rank"]] == r["lsn"]], False
+
+    def clear_resync(self) -> None:
+        with self._cond:
+            self.resync_needed = False
+
+
+class ReplicationShipper:
+    """The primary's background thread streaming its WAL to the standby.
+
+    ``state_fn`` produces the full snapshot-v2 state for bootstrap;
+    ``term_fn`` the current fencing term (stamped into every frame);
+    ``on_fenced(term)`` is called when the standby answers with a newer
+    term — the server uses it to fence itself (it has been superseded).
+    """
+
+    def __init__(
+        self,
+        log: ReplicationLog,
+        standby_address,
+        *,
+        state_fn: Callable[[], dict],
+        term_fn: Callable[[], int],
+        on_fenced: Callable[[int], None],
+        metrics=None,
+        timeout: float = 5.0,
+    ) -> None:
+        self.log = log
+        self.standby_address = (str(standby_address[0]),
+                                int(standby_address[1]))
+        self._state_fn = state_fn
+        self._term_fn = term_fn
+        self._on_fenced = on_fenced
+        self._metrics = metrics
+        self.timeout = float(timeout)
+        self._sock: Optional[socket.socket] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.shipped_lsn = 0     # standby-acked prefix
+        self.synced = threading.Event()  # a SYNC has been acked at least once
+        self._backoff = 0.05
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="psds-service-repl-ship")
+        self._thread.start()
+
+    def stop(self, join: bool = True) -> None:
+        self._stop.set()
+        self._close()
+        t, self._thread = self._thread, None
+        if t is not None and join:
+            t.join(timeout=2.0)
+
+    def _close(self) -> None:
+        sock, self._sock = self._sock, None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    # ------------------------------------------------------------- the loop
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                if self._sock is None:
+                    self._connect_and_sync()
+                    continue
+                recs, resync = self.log.take(self.shipped_lsn)
+                if self._stop.is_set():
+                    return
+                if resync:
+                    self.log.clear_resync()
+                    self._close()  # next tick reconnects and re-SYNCs
+                    if self._metrics is not None:
+                        self._metrics.inc("repl_resyncs")
+                    continue
+                # an empty append doubles as the feed-freshness heartbeat
+                self._ship(P.MSG_REPL_APPEND, {
+                    "term": self._term_fn(),
+                    "from_lsn": self.shipped_lsn + 1,
+                    "records": recs,
+                })
+                if recs and self._metrics is not None:
+                    self._metrics.inc("repl_shipped", value=len(recs))
+            except _Fenced:
+                return  # superseded: on_fenced already ran; stop shipping
+            except (ConnectionError, socket.timeout, OSError,
+                    P.ProtocolError):
+                self._close()
+                self._stop.wait(self._backoff)
+                self._backoff = min(1.0, self._backoff * 2)
+
+    def _connect_and_sync(self) -> None:
+        sock = socket.create_connection(self.standby_address,
+                                        timeout=self.timeout)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        sock.settimeout(self.timeout)
+        self._sock = sock
+        state = self._state_fn()
+        # the bootstrap names the lsn the tail continues from: everything
+        # the state dict already reflects is never re-shipped
+        lsn = self.log.lsn
+        self._ship(P.MSG_REPL_SYNC, {"term": self._term_fn(), "lsn": lsn,
+                                     "state": state})
+        self.shipped_lsn = lsn
+        self.log.clear_resync()
+        self._backoff = 0.05
+        self.synced.set()
+        telemetry.event("repl_sync", lsn=lsn)
+
+    def _ship(self, msg_type: int, header: dict) -> None:
+        t0 = time.perf_counter()
+        P.send_msg(self._sock, msg_type, header)
+        reply, rheader, _ = P.recv_msg(self._sock)
+        if reply == P.MSG_ERROR:
+            code = rheader.get("code")
+            if code == "fenced":
+                term = int(rheader.get("term", self._term_fn() + 1))
+                telemetry.event("repl_fenced", term=term)
+                try:
+                    self._on_fenced(term)
+                finally:
+                    self._close()
+                raise _Fenced(term)
+            if code == "repl_gap":
+                self._close()  # reconnect path re-SYNCs
+                if self._metrics is not None:
+                    self._metrics.inc("repl_resyncs")
+                return
+            raise P.ProtocolError(
+                f"standby refused {P.msg_name(msg_type)}: {code!r}")
+        applied = rheader.get("applied_lsn")
+        if applied is not None:
+            self.shipped_lsn = max(self.shipped_lsn, int(applied))
+        if self._metrics is not None:
+            self._metrics.registry.histogram("repl_lag_ms").observe(
+                (time.perf_counter() - t0) * 1e3)
+
+
+class _Fenced(Exception):
+    """Internal shipper signal: the standby promoted past our term."""
+
+    def __init__(self, term: int) -> None:
+        super().__init__(f"fenced at term {term}")
+        self.term = int(term)

@@ -52,15 +52,33 @@ framing).  Design points, in the order they matter:
   clients pick up the remainder stream exactly-once — no index served
   twice or dropped.  The v2 snapshot persists the cascade + watermarks,
   so a killed-and-restarted daemon resumes mid-cascade.
+* **Hot-standby replication** (docs/RESILIENCE.md "Replication &
+  failover").  A primary constructed with ``standby=(host, port)``
+  appends every state-mutating transition — lease grant/release, epoch
+  set, ack-watermark advance, reshard freeze/drain/commit, snapshot
+  seal — to a sequenced in-memory WAL (:mod:`.replication`) and ships
+  it to an ``IndexServer(role='standby')`` over ``REPL_SYNC`` /
+  ``REPL_APPEND`` frames; the standby bootstraps from the full
+  snapshot-v2 state and continuously applies.  Clients learn the
+  standby address at HELLO; on primary loss they re-HELLO the standby
+  with ``failover=true``, which promotes it once its replication feed
+  has been stale for ``repl_feed_timeout`` seconds (or immediately
+  under a forced ``REPL_PROMOTE``).  Promotion bumps a monotonic
+  fencing ``term``; a zombie ex-primary — still accepting after the
+  promotion — learns the winning term through its own shipper and
+  refuses every client write with ``ERROR(code='fenced')``, so
+  split-brain cannot double-serve a span.
 """
 
 from __future__ import annotations
 
+import json
 import os
 import socket
 import threading
 import time
 import warnings
+import zlib
 from collections import OrderedDict
 from typing import Optional
 
@@ -72,9 +90,27 @@ from ..telemetry import annotate as _annotate, span as _span
 from ..utils.checkpoint import load_sampler_state, save_sampler_state
 from . import protocol as P
 from .metrics import ServiceMetrics
+from .replication import ReplicationLog, ReplicationShipper
 from .spec import PartialShuffleSpec
 
 SNAPSHOT_KIND = "index_service"
+
+#: message types that mutate server state — the ones a fencing term
+#: guards and a standby refuses pre-promotion (observability ops and the
+#: REPL_* feed are exempt)
+_MUTATING_MSGS = frozenset({
+    P.MSG_HELLO, P.MSG_GET_BATCH, P.MSG_SET_EPOCH, P.MSG_HEARTBEAT,
+    P.MSG_LEAVE, P.MSG_RESHARD,
+})
+
+
+def _state_crc(state: dict) -> int:
+    """CRC32 over the canonical JSON of ``state`` minus its own crc
+    field — what ``_write_snapshot`` embeds and ``_restore`` verifies,
+    so a torn/corrupted snapshot is refused instead of half-applied."""
+    body = json.dumps({k: v for k, v in state.items() if k != "crc32"},
+                      sort_keys=True, separators=(",", ":")).encode()
+    return zlib.crc32(body) & 0xFFFFFFFF
 
 
 class IndexServer:
@@ -109,9 +145,15 @@ class IndexServer:
         max_cached_arrays: Optional[int] = None,
         metrics: Optional[ServiceMetrics] = None,
         clock=time.monotonic,
+        role: str = "primary",
+        standby=None,
+        repl_feed_timeout: float = 2.0,
     ) -> None:
         if max_inflight < 1:
             raise ValueError(f"max_inflight must be >= 1, got {max_inflight}")
+        if role not in ("primary", "standby"):
+            raise ValueError(f"role must be 'primary' or 'standby', "
+                             f"got {role!r}")
         self.spec = spec
         self.host, self.port = host, int(port)
         self.max_inflight = int(max_inflight)
@@ -165,6 +207,28 @@ class IndexServer:
         self._stop = threading.Event()
         self._draining = threading.Event()
         self._snapshot_error_warned = False
+        # ---- hot-standby replication (docs/RESILIENCE.md) ----
+        #: 'primary' serves clients and (optionally) ships its WAL;
+        #: 'standby' applies the feed and refuses data ops until promoted
+        self.role = role
+        #: monotonic fencing term; promotion bumps it, every REPL frame
+        #: and post-failover client write carries it
+        self.term = 0
+        #: set when a newer term superseded this server: every client
+        #: write is refused with ERROR(code='fenced') from then on
+        self._fenced_term: Optional[int] = None
+        self._standby_addr = (
+            None if standby is None
+            else (str(standby[0]), int(standby[1]))
+        )
+        self.repl_feed_timeout = float(repl_feed_timeout)
+        self._repl_log: Optional[ReplicationLog] = None
+        self._shipper: Optional[ReplicationShipper] = None
+        # standby-side feed state
+        self._applied_lsn = 0
+        self._feed_last: Optional[float] = None
+        self._primary_addr = None       # learned from REPL_SYNC
+        self._seal_pending = False
 
     # ------------------------------------------------------------ lifecycle
     def start(self) -> tuple[str, int]:
@@ -187,6 +251,16 @@ class IndexServer:
                              name="psds-service-accept")
         t.start()
         self._threads.append(t)
+        if self.role == "primary" and self._standby_addr is not None:
+            self._repl_log = ReplicationLog(metrics=self.metrics)
+            self._shipper = ReplicationShipper(
+                self._repl_log, self._standby_addr,
+                state_fn=self._repl_sync_state,
+                term_fn=lambda: self.term,
+                on_fenced=self._fence,
+                metrics=self.metrics,
+            )
+            self._shipper.start()
         return self.host, self.port
 
     @property
@@ -208,6 +282,9 @@ class IndexServer:
         (``leaked_threads``) and warned about rather than silently
         abandoned."""
         self._draining.set()
+        shipper, self._shipper = self._shipper, None
+        if shipper is not None:
+            shipper.stop()
         ls, self._listener = self._listener, None
         if ls is not None:
             try:
@@ -241,6 +318,36 @@ class IndexServer:
         self._threads.clear()
         self._write_snapshot(force=True)
 
+    def kill(self) -> None:
+        """Abrupt death for failover drills: the ``kill -9`` a ``stop()``
+        is not.  No drain window, no final snapshot, no goodbye frames —
+        the listener and every connection just disappear, exactly what
+        clients of a preempted primary observe."""
+        self._stop.set()
+        shipper, self._shipper = self._shipper, None
+        if shipper is not None:
+            shipper.stop(join=False)
+        ls, self._listener = self._listener, None
+        if ls is not None:
+            try:
+                ls.close()
+            except OSError:
+                pass
+        with self._lock:
+            socks = list(self._conn_socks.values())
+        for s in socks:
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
+        for t in self._threads:
+            t.join(timeout=1.0)
+        self._threads.clear()
+
     def __enter__(self) -> "IndexServer":
         self.start()
         return self
@@ -257,35 +364,57 @@ class IndexServer:
         grace deadlines are monotonic-clock-relative and do NOT persist
         (a restarted drain falls back to ``membership_timeout``)."""
         with self._lock:
-            state = {
-                "kind": SNAPSHOT_KIND,
-                "format": 2,
-                "proto": P.PROTOCOL_VERSION,
-                "spec": self.spec.to_wire(),
-                "epoch": self.epoch,
-                "generation": self.generation,
-                "layers": [[int(w), int(c)] for w, c in self.layers],
-                "elastic_epoch": self.elastic_epoch,
-                "orphans": [dict(o) for o in self._orphans],
-                "cursors": {
-                    str(r): dict(c) for r, c in self._cursors.items()
-                },
+            return self._state_dict_locked()
+
+    def _state_dict_locked(self) -> dict:
+        state = {
+            "kind": SNAPSHOT_KIND,
+            "format": 2,
+            "proto": P.PROTOCOL_VERSION,
+            "spec": self.spec.to_wire(),
+            "epoch": self.epoch,
+            "generation": self.generation,
+            "term": int(self.term),
+            "layers": [[int(w), int(c)] for w, c in self.layers],
+            "elastic_epoch": self.elastic_epoch,
+            "orphans": [dict(o) for o in self._orphans],
+            "cursors": {
+                str(r): dict(c) for r, c in self._cursors.items()
+            },
+            # lease batch sizes: a standby needs them for the drain
+            # gate ((acked+1)*batch >= target); ownership does not
+            # replicate — every lease is vacant on the peer
+            "leases": {str(r): int(l.get("batch") or 0)
+                       for r, l in self._leases.items()},
+        }
+        rs = self._reshard
+        if rs is not None and rs.get("phase") == "drain":
+            state["reshard"] = {
+                "target_world": int(rs["target_world"]),
+                "epoch": int(rs["epoch"]),
+                "barrier_units": int(rs["barrier_units"]),
+                "targets": {str(r): int(t)
+                            for r, t in rs["targets"].items()},
+                "drained": sorted(rs["drained"]),
+                "dead": sorted(rs["dead"]),
+                "leaving": sorted(rs["leaving"]),
             }
-            rs = self._reshard
-            if rs is not None and rs.get("phase") == "drain":
-                state["reshard"] = {
-                    "target_world": int(rs["target_world"]),
-                    "epoch": int(rs["epoch"]),
-                    "barrier_units": int(rs["barrier_units"]),
-                    "targets": {str(r): int(t)
-                                for r, t in rs["targets"].items()},
-                    "drained": sorted(rs["drained"]),
-                    "dead": sorted(rs["dead"]),
-                    "leaving": sorted(rs["leaving"]),
-                }
-            return state
+        return state
 
     def _restore(self, state: dict) -> None:
+        crc = state.get("crc32")
+        if crc is not None and _state_crc(state) != int(crc):
+            # a torn/corrupted snapshot must be refused, not half-loaded:
+            # correctness never depends on it (streams are pure), so the
+            # server starts fresh — loudly
+            self.metrics.inc("snapshot_corrupt")
+            warnings.warn(
+                f"IndexServer: snapshot {self.snapshot_path!r} failed its "
+                f"CRC32 check (stored {int(crc)}, computed "
+                f"{_state_crc(state)}); refusing the corrupted snapshot "
+                "and starting fresh", RuntimeWarning,
+            )
+            return
         if state.get("kind") != SNAPSHOT_KIND:
             raise ValueError(
                 f"snapshot kind {state.get('kind')!r} is not a "
@@ -319,6 +448,12 @@ class IndexServer:
             if fmt < 2:
                 return
             self.generation = int(state.get("generation", 0))
+            self.term = max(self.term, int(state.get("term", 0)))
+            for r, b in (state.get("leases") or {}).items():
+                l = self._leases.setdefault(
+                    int(r), {"owner": None, "last_seen": self._clock(),
+                             "batch": 0})
+                l["batch"] = int(b)
             self.layers = [(int(w), int(c))
                            for w, c in state.get("layers") or []]
             ee = state.get("elastic_epoch")
@@ -360,9 +495,14 @@ class IndexServer:
                 return
             self._unsnapshotted = 0
         state = self._state_dict()
+        state["crc32"] = _state_crc(state)
         try:
             F.fire("server.snapshot_write")
-            save_sampler_state(self.snapshot_path, state)
+            save_sampler_state(self.snapshot_path, state, durable=True)
+            if self._repl_log is not None:
+                # the seal marks the durable point in the WAL: a standby
+                # with its own snapshot_path persists at the same cadence
+                self._repl_log.append("seal", {})
         except OSError as exc:
             # The snapshot is operational state, never a correctness
             # dependency (streams are pure functions of the spec) — a
@@ -376,6 +516,258 @@ class IndexServer:
                     f"{self.snapshot_path!r} failed ({exc!r}); serving "
                     "continues without persistence", RuntimeWarning,
                 )
+
+    # ------------------------------------------- hot-standby replication
+    def _repl_append(self, op: str, **data) -> None:
+        """Append one WAL record when replication is on (no-op
+        otherwise).  Safe under ``self._lock`` — the log has its own
+        lock and never takes the server's."""
+        log = self._repl_log
+        if log is not None:
+            log.append(op, data)
+
+    def _repl_sync_state(self) -> dict:
+        state = self._state_dict()
+        # the SYNC bootstrap also teaches the standby where the primary
+        # serves, so its 'standby' refusals can redirect misrouted clients
+        state["primary_addr"] = [self.host, self.port]
+        return state
+
+    def _fence(self, term: int) -> None:
+        """A newer term exists (the standby promoted past this server):
+        refuse every client write from here on — split-brain must not
+        double-serve a span.  Observability ops keep being served."""
+        with self._lock:
+            if self._fenced_term is None or int(term) > self._fenced_term:
+                self._fenced_term = int(term)
+        self.metrics.inc("fenced")
+        telemetry.event("fenced", term=int(term))
+
+    def _try_promote(self, force: bool = False) -> bool:
+        """Standby → primary, gated on feed staleness: while the
+        replication feed is fresh the primary is alive and the promotion
+        is refused (split-brain guard); ``force`` overrides for an
+        operator-driven switchover."""
+        with self._lock:
+            if self.role != "standby":
+                return True
+            if not force:
+                last = self._feed_last
+                if last is not None and \
+                        self._clock() - last <= self.repl_feed_timeout:
+                    return False  # the primary's feed is alive
+            try:
+                F.fire("repl.promote")
+            except F.InjectedThreadDeath:
+                raise
+            except Exception:
+                # the fault fires BEFORE any state flips: still a
+                # standby, and the failing-over client simply retries
+                return False
+            self.term = int(self.term) + 1
+            self.role = "primary"
+            rs = self._reshard
+            if rs is not None and rs.get("phase") == "drain":
+                # every lease is vacant on the promoted peer: put each
+                # un-drained participant on the membership_timeout clock
+                # so one that never fails over cannot deadlock the drain
+                now = self._clock()
+                for r in rs["targets"]:
+                    if r not in rs["drained"] and r not in rs["dead"]:
+                        self._vacated.setdefault(r, now)
+            self.metrics.inc("promotions")
+            term = self.term
+        telemetry.event("promoted", term=term)
+        return True
+
+    def _standby_refusal(self) -> dict:
+        with self._lock:
+            pa = self._primary_addr
+            return {
+                "code": "standby", "retry_ms": 100, "term": int(self.term),
+                "primary": (list(pa) if pa is not None else None),
+                "detail": "this server is a hot standby; data ops are "
+                          "refused until a promotion",
+            }
+
+    def _term_refusal(self, header: dict) -> Optional[dict]:
+        """The fencing gate on every mutating request (docs/RESILIENCE.md
+        "Split-brain fencing").  Returns the ERROR header to refuse with,
+        or None when the request may proceed."""
+        t = header.get("term")
+        with self._lock:
+            if self._fenced_term is not None:
+                refusal = {
+                    "code": "fenced", "term": int(self._fenced_term),
+                    "serving": False,
+                    "detail": "this server was superseded by a promotion "
+                              f"to term {self._fenced_term}; fail over",
+                }
+            elif t is not None and int(t) > self.term:
+                # the request rode through a promotion this server never
+                # saw — so this server IS the zombie: fence it on the spot
+                self._fenced_term = int(t)
+                refusal = {
+                    "code": "fenced", "term": int(t), "serving": False,
+                    "detail": f"request term {t} proves a newer primary "
+                              "exists; this server is fenced",
+                }
+            elif t is not None and int(t) < self.term:
+                return {
+                    "code": "fenced", "term": int(self.term),
+                    "serving": True,
+                    "detail": f"request term {t} is stale; adopt term "
+                              f"{self.term} and retry",
+                }
+            else:
+                return None
+        # a zombie refusing a write — the chaos matrix's injection point
+        try:
+            F.fire("server.zombie_write")
+        except F.InjectedThreadDeath:
+            raise
+        except Exception:
+            pass  # an injected fault must not un-refuse the write
+        self.metrics.inc("fenced_writes")
+        return refusal
+
+    def _apply_state_locked(self, state: dict) -> None:
+        """Adopt a full replicated state dict (REPL_SYNC bootstrap, or a
+        ``state`` WAL record carrying a reshard drain-flip/commit).
+        Trusting by design — the feed already carries a winning term."""
+        pa = state.get("primary_addr")
+        if pa is not None:
+            self._primary_addr = (str(pa[0]), int(pa[1]))
+        wire = state.get("spec")
+        if wire is not None:
+            theirs = PartialShuffleSpec.from_wire(
+                wire, backend=self.spec.backend)
+            if theirs.world != self.spec.world:
+                self.spec = self.spec.with_world(theirs.world)
+        self.epoch = int(state.get("epoch", 0))
+        self.generation = int(state.get("generation", 0))
+        self.term = max(self.term, int(state.get("term", 0)))
+        self.layers = [(int(w), int(c))
+                       for w, c in state.get("layers") or []]
+        ee = state.get("elastic_epoch")
+        self.elastic_epoch = None if ee is None else int(ee)
+        self._orphans = [dict(o) for o in state.get("orphans") or []]
+        self._cursors = {
+            int(r): {"epoch": int(c["epoch"]), "acked": int(c["acked"]),
+                     "hi": int(c["hi"]),
+                     "samples": int(c.get("samples", 0))}
+            for r, c in (state.get("cursors") or {}).items()
+        }
+        for r, b in (state.get("leases") or {}).items():
+            l = self._leases.setdefault(
+                int(r), {"owner": None, "last_seen": self._clock(),
+                         "batch": 0})
+            l["batch"] = int(b)
+        rs = state.get("reshard")
+        if rs is not None:
+            self._reshard = {
+                "phase": "drain",
+                "target_world": int(rs["target_world"]),
+                "epoch": int(rs["epoch"]),
+                "barrier_units": int(rs["barrier_units"]),
+                "targets": {int(r): int(t)
+                            for r, t in rs["targets"].items()},
+                "drained": {int(r) for r in rs.get("drained", [])},
+                "dead": {int(r) for r in rs.get("dead", [])},
+                "leaving": {int(r): None for r in rs.get("leaving", [])},
+            }
+        else:
+            self._reshard = None
+
+    def _apply_record_locked(self, rec: dict) -> None:
+        op = rec.get("op")
+        if op == "epoch":
+            self.epoch = int(rec["epoch"])
+        elif op == "lease":
+            l = self._leases.setdefault(
+                int(rec["rank"]), {"owner": None,
+                                   "last_seen": self._clock(), "batch": 0})
+            l["batch"] = int(rec.get("batch") or 0)
+            l["last_seen"] = self._clock()
+        elif op == "lease_release":
+            l = self._leases.get(int(rec["rank"]))
+            if l is not None:
+                l["owner"] = None
+            self._vacated.setdefault(int(rec["rank"]), self._clock())
+        elif op == "cursor":
+            self._cursors[int(rec["rank"])] = {
+                "epoch": int(rec["epoch"]), "acked": int(rec["acked"]),
+                "hi": int(rec["hi"]), "samples": int(rec["samples"]),
+            }
+        elif op == "state":
+            self._apply_state_locked(rec.get("state") or {})
+        elif op == "seal":
+            self._seal_pending = True
+        # unknown ops fall through: the record vocabulary is additive
+
+    def _on_repl_sync(self, sock, header) -> None:
+        term = int(header.get("term", 0))
+        with self._lock:
+            if self.role == "primary" or term < self.term:
+                P.send_msg(sock, P.MSG_ERROR, {
+                    "code": "fenced", "term": int(self.term),
+                    "serving": self.role == "primary",
+                    "detail": "REPL_SYNC from a superseded primary",
+                })
+                return
+            self._apply_state_locked(header.get("state") or {})
+            self.term = max(self.term, term)
+            self._applied_lsn = int(header.get("lsn", 0))
+            self._feed_last = self._clock()
+            applied = self._applied_lsn
+        telemetry.event("repl_synced", lsn=applied, term=term)
+        P.send_msg(sock, P.MSG_OK, {"applied_lsn": applied})
+
+    def _on_repl_append(self, sock, header) -> None:
+        term = int(header.get("term", 0))
+        with self._lock:
+            if self.role == "primary" or term < self.term:
+                P.send_msg(sock, P.MSG_ERROR, {
+                    "code": "fenced", "term": int(self.term),
+                    "serving": self.role == "primary",
+                    "detail": "REPL_APPEND from a superseded primary",
+                })
+                return
+            self.term = max(self.term, term)
+            self._feed_last = self._clock()
+            recs = header.get("records") or []
+            from_lsn = int(header.get("from_lsn", 0))
+            if recs and from_lsn > self._applied_lsn + 1:
+                P.send_msg(sock, P.MSG_ERROR, {
+                    "code": "repl_gap",
+                    "applied_lsn": int(self._applied_lsn),
+                    "detail": f"append starts at lsn {from_lsn}; applied "
+                              f"prefix ends at {self._applied_lsn}",
+                })
+                return
+            for rec in recs:
+                lsn = int(rec.get("lsn", 0))
+                if lsn <= self._applied_lsn:
+                    continue  # idempotent overlap after a re-SYNC
+                self._apply_record_locked(rec)
+                self._applied_lsn = lsn
+            applied = self._applied_lsn
+            seal, self._seal_pending = self._seal_pending, False
+        if seal:
+            self._write_snapshot(force=True)
+        P.send_msg(sock, P.MSG_OK, {"applied_lsn": applied})
+
+    def _on_repl_promote(self, sock, header) -> None:
+        if self.role == "primary":
+            P.send_msg(sock, P.MSG_OK,
+                       {"promoted": False, "term": int(self.term),
+                        "detail": "already primary"})
+            return
+        if self._try_promote(force=bool(header.get("force"))):
+            P.send_msg(sock, P.MSG_OK,
+                       {"promoted": True, "term": int(self.term)})
+        else:
+            P.send_msg(sock, P.MSG_ERROR, self._standby_refusal())
 
     # ------------------------------------------------------------ the cache
     def _gen_layers_locked(self, epoch: int):
@@ -475,6 +867,7 @@ class IndexServer:
                 if now - lease["last_seen"] > self.heartbeat_timeout:
                     lease["owner"] = None
                     self._vacated.setdefault(rank, now)
+                    self._repl_append("lease_release", rank=rank)
                     self.metrics.inc("evictions", rank)
                     # eviction ends the rank's tenure: archive its
                     # per-client counters (AFTER counting the eviction,
@@ -497,11 +890,16 @@ class IndexServer:
         died mid-flight, and trigger the eviction reshard for ranks vacant
         past ``membership_timeout`` — so a drain can never deadlock on a
         preempted host and a permanently-lost rank shrinks the world."""
+        if self.role == "standby":
+            # a standby mirrors the primary's decisions; it must not
+            # commit or trigger barriers of its own until promoted
+            return
         trigger = None
         committed = False
         with self._lock:
             rs = self._reshard
             if rs is not None and rs.get("phase") == "drain":
+                dead0 = len(rs["dead"])
                 for r in rs["targets"]:
                     if r in rs["drained"] or r in rs["dead"]:
                         continue
@@ -522,6 +920,9 @@ class IndexServer:
                     raise
                 except Exception:
                     pass  # injected commit fault: state intact, retried
+                if not committed and len(rs["dead"]) > dead0:
+                    self._repl_append("state",
+                                      state=self._state_dict_locked())
             elif (rs is None and self.membership_timeout is not None
                     and self.spec.world > 1 and not self._draining.is_set()):
                 gone = {
@@ -595,6 +996,7 @@ class IndexServer:
                 if lease.get("owner") == conn_id:
                     lease["owner"] = None
                     self._vacated.setdefault(rank, self._clock())
+                    self._repl_append("lease_release", rank=rank)
 
     def _touch(self, rank: int, lease: dict) -> None:
         now = self._clock()
@@ -615,6 +1017,28 @@ class IndexServer:
                 "retry_ms": 200,
             })
             return
+        if msg == P.MSG_REPL_SYNC:
+            self._on_repl_sync(sock, header)
+            return
+        if msg == P.MSG_REPL_APPEND:
+            self._on_repl_append(sock, header)
+            return
+        if msg == P.MSG_REPL_PROMOTE:
+            self._on_repl_promote(sock, header)
+            return
+        if msg in _MUTATING_MSGS:
+            if self.role == "standby":
+                # a failover HELLO may promote (once the feed is stale);
+                # everything else is refused until the promotion
+                if not (msg == P.MSG_HELLO and header.get("failover")
+                        and self._try_promote()):
+                    P.send_msg(sock, P.MSG_ERROR, self._standby_refusal())
+                    return
+            refusal = self._term_refusal(header)
+            if refusal is not None:
+                _annotate(error_code="fenced")
+                P.send_msg(sock, P.MSG_ERROR, refusal)
+                return
         if msg == P.MSG_HELLO:
             self._on_hello(sock, conn_id, header)
         elif msg == P.MSG_GET_BATCH:
@@ -622,6 +1046,7 @@ class IndexServer:
         elif msg == P.MSG_SET_EPOCH:
             with self._lock:
                 self.epoch = int(header.get("epoch", 0))
+                self._repl_append("epoch", epoch=self.epoch)
             self._write_snapshot(force=True)
             P.send_msg(sock, P.MSG_OK, {"epoch": self.epoch})
         elif msg == P.MSG_HEARTBEAT:
@@ -669,6 +1094,7 @@ class IndexServer:
                     cur = self._cursors.get(rank)
                     if cur is not None and cur["epoch"] == int(epoch):
                         cur["acked"] = max(cur["acked"], int(ack))
+                        self._repl_append("cursor", rank=rank, **cur)
                         rs = self._reshard
                         if (rs is not None and rs.get("phase") == "drain"
                                 and int(epoch) == rs["epoch"]
@@ -684,6 +1110,10 @@ class IndexServer:
                                 raise
                             except Exception:
                                 pass  # commit fault: drain intact, retried
+                            if not committed:
+                                self._repl_append(
+                                    "state",
+                                    state=self._state_dict_locked())
             gen = self.generation
         if committed:
             self._write_snapshot(force=True)
@@ -700,6 +1130,7 @@ class IndexServer:
             "layers": [[int(w), int(c)] for w, c in self.layers],
             "elastic_epoch": self.elastic_epoch,
             "orphans": [dict(o) for o in self._orphans],
+            "vacated": sorted(int(r) for r in self._vacated),
         }
 
     def _resharded_err_locked(self, detail: str) -> dict:
@@ -799,6 +1230,9 @@ class IndexServer:
                 )
                 rs["t_drain"] = time.perf_counter()
                 self.metrics.inc("reshard_triggers")
+                # the freeze→drain flip ships wholesale: the standby
+                # applies barriers with the snapshot-restore code path
+                self._repl_append("state", state=self._state_dict_locked())
             self.metrics.registry.histogram("barrier_freeze_ms").observe(
                 (rs["t_drain"] - t_freeze) * 1e3)
             telemetry.event("reshard_drain", target_world=target_world,
@@ -911,6 +1345,10 @@ class IndexServer:
                 (time.perf_counter() - t_drain) * 1e3)
         telemetry.event("reshard_commit", generation=self.generation,
                         world=self.spec.world)
+        # the commit record is in the WAL before any client can observe
+        # the new generation (we still hold the lock), so a standby can
+        # never serve gen+1 requests against pre-commit state
+        self._repl_append("state", state=self._state_dict_locked())
         return True
 
     def _on_leave(self, sock, conn_id, header) -> None:
@@ -1076,12 +1514,16 @@ class IndexServer:
                 })
                 return
             self._leases[rank]["batch"] = batch
+            self._repl_append("lease", rank=rank, batch=batch)
             if rank in self._cursors:
                 self.metrics.inc("reconnects", rank)
             welcome = {
                 "proto": P.PROTOCOL_VERSION,
                 "rank": rank,
                 "spec": self.spec.to_wire(),
+                "term": int(self.term),
+                "standby": (list(self._standby_addr)
+                            if self._standby_addr is not None else None),
                 **self._membership_locked(),
             }
         self._write_snapshot()
@@ -1208,6 +1650,10 @@ class IndexServer:
                             raise
                         except Exception:
                             pass  # commit fault: drain intact, retried
+                        if not committed:
+                            self._repl_append(
+                                "state",
+                                state=self._state_dict_locked())
                         if leaving:
                             # terminal EOF: the leaving stream ends
                             reply = (P.MSG_BATCH,
@@ -1279,6 +1725,7 @@ class IndexServer:
                 if cur is not None and cur["epoch"] == epoch:
                     cur["hi"] = max(cur["hi"], seq)
                     cur["samples"] = max(int(cur.get("samples", 0)), end)
+                    self._repl_append("cursor", rank=rank, **cur)
         if stale is not None:
             P.send_msg(sock, P.MSG_ERROR, stale)
             return

@@ -13,14 +13,28 @@ import os
 import tempfile
 
 
-def save_sampler_state(path: str, state: dict) -> None:
-    """Atomic json write (rename over), safe against mid-write crashes."""
+def save_sampler_state(path: str, state: dict, *, durable: bool = False) -> None:
+    """Atomic json write (rename over), safe against mid-write crashes.
+
+    ``durable=True`` additionally fsyncs the temp file before the rename
+    and the directory after it, so the rename itself survives a power
+    loss — without it the atomic rename only protects against *process*
+    crashes (the OS may reorder the data and rename writes on disk)."""
     d = os.path.dirname(os.path.abspath(path)) or "."
     fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
     try:
         with os.fdopen(fd, "w") as f:
             json.dump(state, f)
+            if durable:
+                f.flush()
+                os.fsync(f.fileno())
         os.replace(tmp, path)
+        if durable:
+            dfd = os.open(d, os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
     except BaseException:
         if os.path.exists(tmp):
             os.unlink(tmp)

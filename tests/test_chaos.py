@@ -558,3 +558,112 @@ def test_snapshot_disk_full_does_not_stop_serving(tmp_path):
     snap_warnings = [w for w in caught
                      if "snapshot write" in str(w.message)]
     assert len(snap_warnings) == 1
+
+
+# ------------------------------------------- hot-standby replication faults
+def _replicated_pair(spec, feed_timeout=0.25):
+    standby = IndexServer(spec, role="standby",
+                          repl_feed_timeout=feed_timeout)
+    standby.start()
+    primary = IndexServer(spec, standby=standby.address,
+                          repl_feed_timeout=feed_timeout)
+    primary.start()
+    return primary, standby
+
+
+def _wait_synced(primary, standby, timeout=10.0):
+    t0 = time.monotonic()
+    while not (primary._shipper is not None
+               and primary._shipper.synced.is_set()
+               and standby._applied_lsn >= primary._repl_log.lsn):
+        if time.monotonic() - t0 > timeout:
+            raise AssertionError("standby never caught up")
+        time.sleep(0.01)
+
+
+def test_repl_append_fault_never_touches_the_serving_path():
+    """A WAL append that dies must cost the standby a re-SYNC, never the
+    clients a byte: the stream stays bit-identical and the log heals."""
+    spec = plain_spec(world=1)
+    ref = np.asarray(spec.rank_indices(0, 0))
+    plan = F.FaultPlan([F.FaultRule(site="repl.append", kind="error",
+                                    nth=2, count=2)])
+    with plan:
+        primary, standby = _replicated_pair(spec)
+        try:
+            with ServiceIndexClient(primary.address, rank=0, batch=37,
+                                    backoff_base=0.01) as client:
+                got = client.epoch_indices(0)
+            _wait_synced(primary, standby)
+            assert standby._cursors.get(0, {}).get("epoch") == 0
+        finally:
+            primary.stop()
+            standby.stop()
+    assert plan.fired("repl.append") > 0, "fault never fired; vacuous"
+    assert np.array_equal(got, ref), "stream diverged under repl.append"
+    counters = primary.metrics.report()["counters"]
+    assert counters.get("repl_append_errors", 0) >= 1
+    assert counters.get("repl_resyncs", 0) >= 1
+
+
+def test_repl_promote_fault_aborts_then_retry_succeeds():
+    """The first promotion attempt dies BEFORE any state flips: the
+    failing-over client just retries, the second attempt promotes, and
+    the stream is still exactly-once."""
+    spec = plain_spec(world=1)
+    ref = np.asarray(spec.rank_indices(0, 0))
+    plan = F.FaultPlan([F.FaultRule(site="repl.promote", kind="error",
+                                    nth=1, count=1)])
+    with plan:
+        primary, standby = _replicated_pair(spec)
+        client = ServiceIndexClient(primary.address, rank=0, batch=37,
+                                    backoff_base=0.01,
+                                    reconnect_timeout=2.0)
+        try:
+            it = client.epoch_batches(0)
+            got = [next(it)]
+            _wait_synced(primary, standby)
+            primary.kill()
+            got.extend(it)
+        finally:
+            client.close()
+            primary.kill()
+            standby.stop()
+    assert plan.fired("repl.promote") > 0, "fault never fired; vacuous"
+    assert standby.role == "primary", "retry after the aborted promotion"
+    assert np.array_equal(np.concatenate(got), ref)
+    counters = client.metrics.report()["counters"]
+    assert counters.get("degraded_mode", 0) == 0
+
+
+def test_zombie_write_refusal_survives_injected_fault():
+    """The fencing refusal is load-bearing: even with a fault injected
+    at the refusal site, the zombie's write is still refused with the
+    typed ``fenced`` error carrying the new term, and its state never
+    mutates."""
+    spec = plain_spec(world=1)
+    plan = F.FaultPlan([F.FaultRule(site="server.zombie_write",
+                                    kind="error", count=0)])
+    with plan:
+        primary, standby = _replicated_pair(spec, feed_timeout=60.0)
+        try:
+            _wait_synced(primary, standby)
+            epoch_before = primary.epoch
+            assert standby._try_promote(force=True)
+            sock = socket.create_connection(primary.address, timeout=5.0)
+            try:
+                P.send_msg(sock, P.MSG_HELLO,
+                           {"proto": P.PROTOCOL_VERSION, "rank": 0,
+                            "batch": 32, "term": standby.term})
+                msg, header, _ = P.recv_msg(sock)
+            finally:
+                sock.close()
+            assert msg == P.MSG_ERROR
+            assert header["code"] == "fenced"
+            assert header["term"] >= standby.term
+            assert header["serving"] is False
+            assert primary.epoch == epoch_before
+        finally:
+            primary.stop()
+            standby.stop()
+    assert plan.fired("server.zombie_write") > 0, "fault never fired"
