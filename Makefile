@@ -37,11 +37,16 @@
 #                          replication: kill-mid-epoch bit-identity and
 #                          zombie fencing, then the failover-stall +
 #                          shipping-overhead-within-noise bar
+#   * tenancy smoke        tests/test_tenancy.py (`-m tenancy`)
+#                          + benchmarks/tenancy_smoke.py — multi-tenant
+#                          namespaces: two-tenant bit-identity, fair-
+#                          share starvation bound, admission quotas,
+#                          then the co-residency-within-noise bar
 
 PY ?= python
 
 .PHONY: check test bench native dryrun service-smoke chaos-smoke \
-	elastic-smoke telemetry-smoke failover-smoke
+	elastic-smoke telemetry-smoke failover-smoke tenancy-smoke
 
 # the driver parses the LAST line of bench.py's combined output (round 3
 # lost its headline to the details line — BENCH_r03.json "parsed": null),
@@ -96,6 +101,13 @@ elastic-smoke:
 failover-smoke:
 	$(PY) -m pytest tests/test_failover.py -q -m failover -ra
 	$(PY) benchmarks/failover_smoke.py
+
+# tenancy gate (docs/SERVICE.md "Tenancy"): the multi-tenant suite
+# (per-namespace bit-identity, fair-share scheduling, admission quotas,
+# multi-tenant failover), then the co-residency-overhead smoke
+tenancy-smoke:
+	$(PY) -m pytest tests/test_tenancy.py -q -m tenancy -ra
+	$(PY) benchmarks/tenancy_smoke.py
 
 # observability gate (docs/OBSERVABILITY.md): trace propagation across
 # the hard paths (reshard refusal, degraded fallback, injected dispatch

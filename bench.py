@@ -37,6 +37,7 @@ torch.randperm(1e9) measured at 94.2 s on this machine (BASELINE.md).
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -51,6 +52,92 @@ SEED = 0
 REPS = 6
 PIPELINE = 8
 HOST_FULL_RANDPERM_MS = 94_200.0  # torch.randperm(1e9), BASELINE.md
+
+
+def _flatten_noise_flags(obj, prefix=""):
+    """Every ``*within_noise`` boolean in a nested report, keyed by its
+    dotted path — the regression tripwire's comparison unit."""
+    out = {}
+    if isinstance(obj, dict):
+        for key, v in obj.items():
+            path = f"{prefix}.{key}" if prefix else str(key)
+            if isinstance(v, (dict, list)):
+                out.update(_flatten_noise_flags(v, path))
+            elif isinstance(v, bool) and key.endswith("within_noise"):
+                out[path] = v
+    elif isinstance(obj, list):
+        for i, v in enumerate(obj):
+            out.update(_flatten_noise_flags(v, f"{prefix}.{i}"))
+    return out
+
+
+def _previous_noise_flags(repo_dir):
+    """``within_noise`` flags recorded by the newest ``BENCH_r*.json``.
+
+    The driver stores only the run's output *tail*, so the embedded
+    details JSON is often truncated mid-line: parse whole JSON lines
+    when possible, and fall back to a lexical scan that keeps the flag's
+    immediate parent key for path alignment.  Returns ``(flags, path)``
+    — both empty/None when there is no usable previous round."""
+    import glob
+    import re
+
+    rounds = sorted(glob.glob(os.path.join(repo_dir, "BENCH_r*.json")))
+    if not rounds:
+        return {}, None
+    prev = rounds[-1]
+    try:
+        with open(prev) as f:
+            tail = json.load(f).get("tail") or ""
+    except (OSError, ValueError):
+        return {}, prev
+    flags = {}
+    for line in tail.splitlines():
+        brace = line.find("{")
+        if brace < 0:
+            continue
+        try:
+            obj = json.loads(line[brace:])
+        except ValueError:
+            continue
+        flags.update(_flatten_noise_flags(obj))
+    if not flags:
+        # truncated tail: recover ``"parent": {... "x_within_noise": b``
+        # pairs lexically (objects in these reports are one level deep
+        # around the flag, so [^{}] suffices for the parent scan)
+        for m in re.finditer(
+                r'"([A-Za-z0-9_]+)":\s*\{[^{}]*?'
+                r'"([A-Za-z0-9_]*within_noise)":\s*(true|false)', tail):
+            flags[f"{m.group(1)}.{m.group(2)}"] = m.group(3) == "true"
+        for m in re.finditer(
+                r'"([A-Za-z0-9_]*within_noise)":\s*(true|false)', tail):
+            # bare-name fallback for flags whose parent key was cut off;
+            # OR across occurrences — a tripwire should err loud
+            flags[m.group(1)] = flags.get(m.group(1), False) or \
+                m.group(2) == "true"
+    return flags, prev
+
+
+def _noise_regressions(prev_flags, cur_flags):
+    """Paths whose flag flipped true -> false against the previous round.
+
+    Previous keys may be truncated paths (the tail is a suffix of the
+    real output), so a current path matches the previous key with the
+    longest aligned segment suffix."""
+    out = []
+    for path, ok in sorted(cur_flags.items()):
+        if ok:
+            continue
+        segs = path.split(".")
+        best, best_len = None, 0
+        for pkey, pval in prev_flags.items():
+            psegs = pkey.split(".")
+            m = min(len(psegs), len(segs))
+            if m > best_len and psegs[-m:] == segs[-m:]:
+                best, best_len = pval, m
+        if best:
+            out.append(path)
+    return out
 
 
 def _anchored_ms_per_epoch(fn, reps=None, pipeline=None):
@@ -318,6 +405,38 @@ def main() -> None:
             details["failover"] = failover_summarize()
         except Exception as exc:
             details["failover_error"] = repr(exc)[:200]
+
+    # detail tier: tenancy — multi-tenant co-residency overhead vs a
+    # dedicated daemon + the concurrent fair-share drill (methodology in
+    # benchmarks/tenancy_smoke.py)
+    if not smoke:
+        try:
+            sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+            from benchmarks.tenancy_smoke import (
+                summarize as tenancy_summarize,
+            )
+
+            details["tenancy"] = tenancy_summarize()
+        except Exception as exc:
+            details["tenancy_error"] = repr(exc)[:200]
+
+    # regression tripwire: any ``*within_noise`` flag that was true in
+    # the previous recorded round and is false now gets a loud line —
+    # a perf regression must never slip through as a silently-flipped
+    # boolean deep in the details blob
+    try:
+        prev_flags, prev_path = _previous_noise_flags(
+            os.path.dirname(os.path.abspath(__file__)))
+        regressions = _noise_regressions(prev_flags,
+                                         _flatten_noise_flags(details))
+        if regressions:
+            details["regressions"] = regressions
+            for path in regressions:
+                print(f"REGRESSION: {path} flipped true -> false vs "
+                      f"{os.path.basename(prev_path)}",
+                      file=sys.stderr, flush=True)
+    except Exception as exc:
+        details["regression_check_error"] = repr(exc)[:200]
 
     print(json.dumps(details), file=sys.stderr, flush=True)
     if not metric_printed:

@@ -26,6 +26,7 @@ SITES = frozenset({
     "repl.append",           # the primary appending a WAL record
     "repl.promote",          # a standby promoting itself to primary
     "client.leave",          # a client announcing its preemption drain
+    "tenant.admission",      # a HELLO admitting / creating a tenant
     "loader.prefetch",       # one step of HostDataLoader's gather thread
     "loader.regen",          # local epoch index generation
 })

@@ -10,15 +10,22 @@ rank leases, reconnect/resume, snapshots, metrics, and elastic
 membership (mid-epoch resharding with preemption-aware drain,
 docs/RESILIENCE.md "Elastic membership").  A primary/standby pair adds
 hot-standby replication: WAL shipping, transparent client failover, and
-split-brain fencing (docs/RESILIENCE.md "Replication & failover").
+split-brain fencing (docs/RESILIENCE.md "Replication & failover").  A
+``multi_tenant=True`` daemon hosts several jobs at once — one namespace
+per world-stripped spec fingerprint, with per-tenant quotas
+(:class:`~..tenancy.TenantQuota`), fair-share regen scheduling
+(:class:`~..tenancy.FairShareScheduler`), and isolated metrics/trace
+views (docs/SERVICE.md "Tenancy").
 """
 
+from ..tenancy import FairShareScheduler, TenantQuota  # noqa: F401
 from .client import (  # noqa: F401
     FencedError,
     ReshardInProgress,
     ServiceError,
     ServiceIndexClient,
     ServiceUnavailable,
+    SpecMismatchError,
 )
 from .metrics import ServiceMetrics  # noqa: F401
 from .protocol import PROTOCOL_VERSION, ProtocolError  # noqa: F401

@@ -43,6 +43,16 @@ after a failover; a fenced zombie primary's typed ``fenced`` refusal
 :class:`FencedError` only when no peer at the winning term is
 reachable.  A client pointed at a standby of a *healthy* pair follows
 the ``standby`` error's ``primary`` redirect instead.
+
+Multi-tenancy (docs/SERVICE.md "Tenancy"): when constructed with
+``spec=``, HELLO carries the full wire spec alongside the world-stripped
+fingerprint, so a multi-tenant daemon can *create* the job's namespace
+on first contact instead of refusing the mismatch.  The WELCOME's
+``tenant`` id is adopted and stamped on every subsequent request (so a
+reconnect or failover lands back in the same namespace), a refused
+attach surfaces as the typed :class:`SpecMismatchError` carrying both
+fingerprints, and a ``tenant_admission`` refusal (per-tenant quota) is
+retried like throttle backpressure using the server's ``retry_ms``.
 """
 
 from __future__ import annotations
@@ -62,8 +72,8 @@ from .metrics import ServiceMetrics
 #: ERROR codes that indicate a configuration/contract problem — retrying
 #: cannot fix them, so they raise immediately
 _FATAL_CODES = frozenset(
-    {"proto", "protocol_version", "world", "spec", "batch", "bad_request",
-     "unknown_type", "protocol", "no_rank"}
+    {"proto", "protocol_version", "world", "spec", "spec_mismatch", "batch",
+     "bad_request", "unknown_type", "protocol", "no_rank"}
 )
 
 #: consecutive checksum rejects on one seq before the client gives up on
@@ -99,6 +109,21 @@ class ReshardInProgress(ServiceError):
         super().__init__("reshard", detail)
 
 
+class SpecMismatchError(ServiceError):
+    """The server's world-stripped spec fingerprint does not match ours
+    and it refused to (or could not) attach a tenant for it — a
+    single-tenant daemon serving a different job, a mis-declared
+    fingerprint, or a multi-tenant daemon at its ``max_tenants``
+    capacity.  Carries both fingerprints so the operator can see *which*
+    config each side holds."""
+
+    def __init__(self, detail: str = "", header: Optional[dict] = None) -> None:
+        super().__init__("spec_mismatch", detail, header)
+        hdr = self.header
+        self.server_fingerprint = hdr.get("server_fingerprint")
+        self.client_fingerprint = hdr.get("client_fingerprint")
+
+
 class FencedError(ServiceError):
     """Every reachable peer refused the request as fenced: a promotion
     to ``term`` superseded the server(s) this client can reach, and no
@@ -110,6 +135,13 @@ class FencedError(ServiceError):
                  header: Optional[dict] = None) -> None:
         super().__init__("fenced", detail, header)
         self.term = int(term)
+
+
+def _typed_error(code: str, detail: str, header: dict) -> ServiceError:
+    """Build the most specific exception type for a server ERROR code."""
+    if code == "spec_mismatch":
+        return SpecMismatchError(detail, header)
+    return ServiceError(code, detail, header)
 
 
 def _parse_address(address):
@@ -181,6 +213,10 @@ class ServiceIndexClient:
                 breaker_threshold=12, breaker_reset=1.0,
             )
         self.metrics = metrics if metrics is not None else ServiceMetrics()
+        #: namespace id adopted from WELCOME (docs/SERVICE.md "Tenancy");
+        #: stamped on every request so a re-dial of a multi-tenant daemon
+        #: lands back in the same tenant even before the re-HELLO binds us
+        self.tenant: Optional[str] = None
         self.spec_wire: Optional[dict] = None
         self.server_epoch: Optional[int] = None
         self._sock: Optional[socket.socket] = None
@@ -234,6 +270,12 @@ class ServiceIndexClient:
             # drifts legitimately; only the stream-shaping config must match
             hello["spec_fingerprint"] = \
                 self.expected_spec.fingerprint(include_world=False)
+            # the full wire spec lets a multi-tenant daemon CREATE the
+            # tenant on first contact (docs/SERVICE.md "Tenancy"); a
+            # single-tenant daemon ignores it
+            hello["spec"] = self.expected_spec.to_wire()
+        if self.tenant is not None:
+            hello["tenant"] = self.tenant
         try:
             P.send_msg(sock, P.MSG_HELLO, hello)
             msg, header, _ = P.recv_msg(sock)
@@ -242,7 +284,7 @@ class ServiceIndexClient:
             raise
         if msg == P.MSG_ERROR:
             sock.close()
-            raise ServiceError(header.get("code", "error"),
+            raise _typed_error(header.get("code", "error"),
                                header.get("detail", ""), header)
         if msg != P.MSG_WELCOME:
             sock.close()
@@ -250,6 +292,9 @@ class ServiceIndexClient:
                 f"expected WELCOME, got {P.msg_name(msg)}"
             )
         self.rank = int(header["rank"])
+        t = header.get("tenant")
+        if t is not None:
+            self.tenant = str(t)
         self.spec_wire = header.get("spec")
         self.server_epoch = header.get("epoch")
         sb = header.get("standby")
@@ -396,6 +441,16 @@ class ServiceIndexClient:
                     if exc.code == "fenced":
                         op = self._on_fenced(exc.header, op, tried)
                         continue
+                    if exc.code == "tenant_admission":
+                        # typed admission backpressure: the tenant is at a
+                        # quota (ranks / creation burst) — wait at least
+                        # the server-suggested interval and re-HELLO
+                        self.metrics.inc("admission_waits", self.rank)
+                        retry_s = float(
+                            exc.header.get("retry_ms", 50)) / 1e3
+                        if not op.pause(min_delay=retry_s):
+                            raise
+                        continue
                     if exc.code not in ("rank_taken", "not_owner"):
                         raise
                     # our own just-dropped lease may not have been released
@@ -413,6 +468,11 @@ class ServiceIndexClient:
                     # the fencing term rides every post-promotion request:
                     # a zombie primary must refuse, not serve, it
                     header["term"] = self.term
+                if self.tenant is not None:
+                    # the tenant binding rides every request: a server-side
+                    # conn that lost its HELLO binding (or a promoted
+                    # standby) still routes to the right namespace
+                    header["tenant"] = self.tenant
                 P.send_msg(self._sock, msg_type, header,
                            site="service.send")
                 reply, rheader, payload = P.recv_msg(self._sock,
@@ -482,7 +542,7 @@ class ServiceIndexClient:
                     self.close()
                     op = self._on_fenced(rheader, op, tried)
                     continue
-                raise ServiceError(code, rheader.get("detail", ""), rheader)
+                raise _typed_error(code, rheader.get("detail", ""), rheader)
             return reply, rheader, payload
 
     # ----------------------------------------------------------- failover

@@ -36,6 +36,14 @@ entry, so a long-lived daemon's report does not grow with every rank
 that ever connected (docs/OBSERVABILITY.md).  The epoch regen timer is
 the same :class:`RegenTimer` every sampler uses, so "epoch regen ms"
 means the same thing here as in a local training loop.
+
+Multi-tenant daemons (docs/SERVICE.md "Tenancy") key per-client counters
+by ``(tenant, client)``: :meth:`scoped` derives one child view per tenant
+with a private registry and a private ``clients``/``departed`` table, so
+one tenant's churn can't pollute another's counters and a tenant METRICS
+poll sees only its own numbers.  Child totals are mirrored into the
+parent registry (the operator's daemon-wide view) and child reports are
+rolled up under ``report()["tenants"][tenant_id]``.
 """
 
 from __future__ import annotations
@@ -62,9 +70,29 @@ class ServiceMetrics:
         self._lock = threading.Lock()
         self.clients: dict[int, dict[str, int]] = {}
         self.departed: dict[str, int] = {}
+        self.tenant: str | None = None
+        self._parent: ServiceMetrics | None = None
+        self._tenants: dict[str, ServiceMetrics] = {}
+
+    def scoped(self, tenant: str) -> "ServiceMetrics":
+        """Per-tenant child view: private registry, private ``clients``
+        table (so per-client counters are effectively keyed by
+        ``(tenant, client)``), totals mirrored into this parent."""
+        tenant = str(tenant)
+        with self._lock:
+            child = self._tenants.get(tenant)
+            if child is None:
+                child = ServiceMetrics()
+                child.tenant = tenant
+                child._parent = self
+                self._tenants[tenant] = child
+            return child
 
     def inc(self, name: str, rank: int | None = None, value: int = 1) -> None:
         self.registry.inc(name, value)
+        if self._parent is not None:
+            # mirror tenant totals into the daemon-wide operator view
+            self._parent.registry.inc(name, value)
         if rank is not None and name in _PER_CLIENT:
             with self._lock:
                 per = self.clients.setdefault(
@@ -100,4 +128,9 @@ class ServiceMetrics:
             }
             if self.departed:
                 out["departed"] = dict(self.departed)
+            if self.tenant is not None:
+                out["tenant"] = self.tenant
+            tenants = dict(self._tenants)
+        if tenants:
+            out["tenants"] = {t: m.report() for t, m in sorted(tenants.items())}
         return out

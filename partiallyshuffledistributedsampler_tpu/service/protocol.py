@@ -70,6 +70,20 @@ retry, False when this server is a fenced zombie and the caller must
 fail over), ``repl_gap`` (an append's ``from_lsn`` does not extend the
 standby's applied prefix; the shipper re-SYNCs).
 
+Tenancy fields and codes (docs/SERVICE.md "Tenancy"): ``HELLO`` MAY
+carry the full wire ``spec`` alongside ``spec_fingerprint`` — a
+multi-tenant daemon uses it to *create* the job's namespace on first
+contact; a single-tenant daemon ignores it.  ``WELCOME`` carries the
+assigned ``tenant`` id, and any request header MAY stamp ``tenant`` to
+name its namespace explicitly (a reconnect that lost its HELLO binding).
+Both ride inside protocol version 2 the same way ``trace`` does —
+additive header fields, ignored by peers that predate them.  Error
+codes: ``spec_mismatch`` (terminal — the fingerprints disagree and no
+tenant can be attached; the header carries both ``server_fingerprint``
+and ``client_fingerprint``, plus ``tenants``/``max_tenants`` when the
+refusal was a capacity limit), ``tenant_admission`` (retryable — a
+per-tenant quota refused the HELLO; the header carries ``retry_ms``).
+
 Tracing: any request header MAY carry ``trace=[trace_id, span_id]`` —
 the sender's open span context (docs/OBSERVABILITY.md).  Receivers that
 know about it parent their dispatch span under it; receivers that don't

@@ -127,15 +127,37 @@ class ReplicationLog:
                 return [], True  # tail rotated past the standby's cursor
             if not recs and self.lsn > after_lsn:
                 return [], True  # everything newer was already dropped
+            # upserts coalesce per (tenant, rank): a multi-tenant primary
+            # tags records with the owning tenant id, and two tenants'
+            # rank-0 cursors must not thin each other
             newest_cursor = {
-                r["rank"]: r["lsn"] for r in recs if r["op"] == "cursor"}
+                (r.get("tenant"), r["rank"]): r["lsn"]
+                for r in recs if r["op"] == "cursor"}
             return [r for r in recs
                     if r["op"] != "cursor"
-                    or newest_cursor[r["rank"]] == r["lsn"]], False
+                    or newest_cursor[(r.get("tenant"), r["rank"])] == r["lsn"]
+                    ], False
 
     def clear_resync(self) -> None:
         with self._cond:
             self.resync_needed = False
+
+
+class TenantTaggedLog:
+    """A tenant engine's view of the front daemon's shared WAL.
+
+    Multi-tenant daemons (docs/SERVICE.md "Tenancy") keep ONE sequenced
+    log; each tenant engine appends through this wrapper, which stamps
+    the owning tenant id into every record so the standby can route it
+    to its mirror of that tenant and ``take()`` can thin cursor upserts
+    per ``(tenant, rank)``."""
+
+    def __init__(self, log: ReplicationLog, tenant: str) -> None:
+        self._log = log
+        self.tenant = str(tenant)
+
+    def append(self, op: str, data: dict) -> None:
+        self._log.append(op, {**data, "tenant": self.tenant})
 
 
 class ReplicationShipper:

@@ -68,6 +68,20 @@ framing).  Design points, in the order they matter:
   promotion — learns the winning term through its own shipper and
   refuses every client write with ``ERROR(code='fenced')``, so
   split-brain cannot double-serve a span.
+* **Multi-tenancy** (docs/SERVICE.md "Tenancy").  With
+  ``multi_tenant=True`` the daemon serves many specs: namespaces are
+  keyed by the world-stripped spec fingerprint, a HELLO carrying an
+  unknown fingerprint plus its spec wire form creates-or-attaches a
+  tenant (up to ``max_tenants``, through the ``tenant.admission`` fault
+  site), and each tenant is an unstarted nested ``IndexServer`` engine
+  owning its own leases/cursors/barriers/snapshot/metrics — the front
+  server routes frames by the connection's HELLO binding (or an
+  additive ``tenant`` header field), runs all tenants' epoch regens
+  through one :class:`~..tenancy.FairShareScheduler`, enforces
+  :class:`~..tenancy.TenantQuota` caps at admission with typed
+  ``retry_ms`` backpressure, tags every WAL record with its tenant so
+  one standby mirrors and fails over ALL tenants, and filters
+  ``TRACE_DUMP`` so one tenant never reads another's spans.
 """
 
 from __future__ import annotations
@@ -80,6 +94,7 @@ import time
 import warnings
 import zlib
 from collections import OrderedDict
+from contextlib import nullcontext
 from typing import Optional
 
 import numpy as np
@@ -87,10 +102,16 @@ import numpy as np
 from .. import faults as F
 from .. import telemetry
 from ..telemetry import annotate as _annotate, span as _span
-from ..utils.checkpoint import load_sampler_state, save_sampler_state
+from ..tenancy import FairShareScheduler, TenantQuota, tenant_id_for
+from ..utils.checkpoint import (
+    list_tenant_snapshots,
+    load_sampler_state,
+    save_sampler_state,
+    tenant_snapshot_path,
+)
 from . import protocol as P
 from .metrics import ServiceMetrics
-from .replication import ReplicationLog, ReplicationShipper
+from .replication import ReplicationLog, ReplicationShipper, TenantTaggedLog
 from .spec import PartialShuffleSpec
 
 SNAPSHOT_KIND = "index_service"
@@ -148,6 +169,10 @@ class IndexServer:
         role: str = "primary",
         standby=None,
         repl_feed_timeout: float = 2.0,
+        multi_tenant: bool = False,
+        max_tenants: int = 8,
+        tenant_quota: Optional[TenantQuota] = None,
+        regen_scheduler: Optional[FairShareScheduler] = None,
     ) -> None:
         if max_inflight < 1:
             raise ValueError(f"max_inflight must be >= 1, got {max_inflight}")
@@ -229,6 +254,39 @@ class IndexServer:
         self._feed_last: Optional[float] = None
         self._primary_addr = None       # learned from REPL_SYNC
         self._seal_pending = False
+        # ---- multi-tenancy (docs/SERVICE.md "Tenancy") ----
+        #: this server's own namespace id — the world-stripped spec
+        #: fingerprint hashed down to a short wire/file-safe token.  A
+        #: single-tenant daemon still has one (it IS its default tenant).
+        self.tenant_id = tenant_id_for(spec.fingerprint(include_world=False))
+        self.multi_tenant = bool(multi_tenant)
+        self.max_tenants = max(1, int(max_tenants))
+        #: quota stamped onto tenants this daemon creates; the default
+        #: tenant (the constructor spec) itself runs unquotaed unless a
+        #: parent stamped one on this engine
+        self.tenant_quota = (tenant_quota if tenant_quota is not None
+                             else TenantQuota())
+        self.quota: Optional[TenantQuota] = None
+        #: tenant engines: unstarted IndexServer instances (no listener,
+        #: no threads) owning one spec's leases/cursors/barriers/snapshot
+        #: each; the front server routes frames into them
+        self._tenants: "OrderedDict[str, IndexServer]" = OrderedDict()
+        self._tenant_by_id: dict[str, "IndexServer"] = {}
+        #: conn_id -> tenant engine bound at HELLO (front server only)
+        self._conn_tenant: dict[int, "IndexServer"] = {}
+        #: the engine's owner when this instance is a tenant engine
+        self._parent: Optional["IndexServer"] = None
+        #: shared fair-share regen queue (engines borrow the front's)
+        self._regen_sched = (
+            regen_scheduler if regen_scheduler is not None
+            else (FairShareScheduler(metrics=self.metrics.registry)
+                  if self.multi_tenant else None)
+        )
+        if (self._regen_sched is not None
+                and self._regen_sched._metrics is None):
+            # a caller-provided queue still reports wait time through
+            # this daemon's registry (``regen_queue_ms``)
+            self._regen_sched._metrics = self.metrics.registry
 
     # ------------------------------------------------------------ lifecycle
     def start(self) -> tuple[str, int]:
@@ -240,6 +298,8 @@ class IndexServer:
         self._draining.clear()
         if self.snapshot_path and os.path.exists(self.snapshot_path):
             self._restore(load_sampler_state(self.snapshot_path))
+        if self.multi_tenant and self.snapshot_path:
+            self._restore_tenants()
         ls = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         ls.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         ls.bind((self.host, self.port))
@@ -253,6 +313,9 @@ class IndexServer:
         self._threads.append(t)
         if self.role == "primary" and self._standby_addr is not None:
             self._repl_log = ReplicationLog(metrics=self.metrics)
+            for eng in self._engines():
+                eng._repl_log = TenantTaggedLog(self._repl_log,
+                                                eng.tenant_id)
             self._shipper = ReplicationShipper(
                 self._repl_log, self._standby_addr,
                 state_fn=self._repl_sync_state,
@@ -316,6 +379,10 @@ class IndexServer:
                 f"{[t.name for t in leaked]}", RuntimeWarning,
             )
         self._threads.clear()
+        for eng in self._engines():
+            eng._draining.set()
+            eng._stop.set()
+            eng._write_snapshot(force=True)
         self._write_snapshot(force=True)
 
     def kill(self) -> None:
@@ -354,6 +421,121 @@ class IndexServer:
 
     def __exit__(self, *exc) -> None:
         self.stop()
+
+    # ------------------------------------------------------------- tenancy
+    def _engines(self) -> list:
+        """The tenant engines (never includes self — the front server IS
+        its own default tenant).  Safe without the lock: the dict only
+        ever grows, and callers tolerate a stale snapshot of it."""
+        return list(self._tenants.values())
+
+    def tenants(self) -> dict:
+        """Public view: ``tenant_id -> world-stripped fingerprint`` for
+        every namespace this daemon serves, the default one included."""
+        out = {self.tenant_id: self.spec.fingerprint(include_world=False)}
+        with self._lock:
+            for fp, eng in self._tenants.items():
+                out[eng.tenant_id] = fp
+        return out
+
+    def _make_tenant_engine(self, spec: PartialShuffleSpec) -> "IndexServer":
+        """Build (and, when its snapshot exists, restore) one tenant
+        engine: an unstarted IndexServer owning the tenant's leases,
+        cursors, barriers, snapshot file, and scoped metrics.  It shares
+        the front server's socket plane, WAL, and fair-share queue."""
+        q = self.tenant_quota
+        tid = tenant_id_for(spec.fingerprint(include_world=False))
+        eng = IndexServer(
+            spec,
+            max_inflight=q.clamp_inflight(self.max_inflight),
+            heartbeat_timeout=self.heartbeat_timeout,
+            membership_timeout=self.membership_timeout,
+            snapshot_path=(tenant_snapshot_path(self.snapshot_path, tid)
+                           if self.snapshot_path else None),
+            snapshot_interval=self.snapshot_interval,
+            metrics=self.metrics.scoped(tid),
+            clock=self._clock,
+            role=self.role,
+            regen_scheduler=self._regen_sched,
+        )
+        eng.quota = q
+        eng._parent = self
+        eng.term = self.term
+        if self._repl_log is not None:
+            eng._repl_log = TenantTaggedLog(self._repl_log, tid)
+        if self._regen_sched is not None:
+            self._regen_sched.set_quota(tid, weight=q.weight,
+                                        concurrency=q.regen_concurrency)
+        if eng.snapshot_path and os.path.exists(eng.snapshot_path):
+            try:
+                eng._restore(load_sampler_state(eng.snapshot_path))
+            except (OSError, ValueError, KeyError) as exc:
+                warnings.warn(
+                    f"IndexServer: tenant snapshot {eng.snapshot_path!r} "
+                    f"not restored ({exc!r}); tenant {tid} starts fresh",
+                    RuntimeWarning,
+                )
+        return eng
+
+    def _register_tenant_locked(self, fp: str, eng: "IndexServer") -> None:
+        self._tenants[fp] = eng
+        self._tenant_by_id[eng.tenant_id] = eng
+
+    def _restore_tenants(self) -> None:
+        """Rediscover per-tenant snapshots next to ``snapshot_path`` on
+        start, so a restarted multi-tenant daemon resumes every
+        namespace, not just its constructor spec's."""
+        own = self.spec.fingerprint(include_world=False)
+        for tid, path in list_tenant_snapshots(self.snapshot_path).items():
+            try:
+                st = load_sampler_state(path)
+                spec = PartialShuffleSpec.from_wire(
+                    st["spec"], backend=self.spec.backend)
+            except (OSError, ValueError, KeyError) as exc:
+                warnings.warn(
+                    f"IndexServer: tenant snapshot {path!r} unreadable "
+                    f"({exc!r}); skipped", RuntimeWarning)
+                continue
+            fp = spec.fingerprint(include_world=False)
+            if fp == own:
+                continue
+            eng = self._make_tenant_engine(spec)
+            with self._lock:
+                if fp not in self._tenants:
+                    self._register_tenant_locked(fp, eng)
+
+    def _apply_tenant_state_locked(self, tid: str, tstate: dict) -> None:
+        """Standby side: route a replicated tenant state (REPL_SYNC's
+        ``tenants`` map, or a ``tenant`` WAL record) into the mirror
+        engine, creating it from the state's spec wire form first if
+        this standby has never seen the tenant.  Under ``self._lock``;
+        lock order is always front → engine."""
+        eng = self._tenant_by_id.get(tid)
+        if eng is None:
+            wire = tstate.get("spec")
+            if wire is None:
+                return
+            spec = PartialShuffleSpec.from_wire(wire,
+                                                backend=self.spec.backend)
+            fp = spec.fingerprint(include_world=False)
+            eng = self._make_tenant_engine(spec)
+            self._register_tenant_locked(fp, eng)
+        with eng._lock:
+            eng._apply_state_locked(tstate)
+
+    def _regen_cost(self) -> float:
+        """Fair-share cost of one epoch regen for this tenant — its
+        per-rank sample count (heavier tenants advance their virtual
+        time faster, so a 1B-sample regen yields the queue sooner)."""
+        n = None
+        try:
+            n = self.spec.num_samples(0)
+        except (TypeError, ValueError):
+            n = None
+        if n is None and self.spec.shard_sizes is not None:
+            n = int(np.sum(self.spec.shard_sizes)) \
+                // max(1, self.spec.world)
+        return float(max(1, n if n is not None else 1))
 
     # ------------------------------------------------------------- snapshot
     def _state_dict(self) -> dict:
@@ -531,6 +713,10 @@ class IndexServer:
         # the SYNC bootstrap also teaches the standby where the primary
         # serves, so its 'standby' refusals can redirect misrouted clients
         state["primary_addr"] = [self.host, self.port]
+        tenants = {eng.tenant_id: eng._state_dict()
+                   for eng in self._engines()}
+        if tenants:
+            state["tenants"] = tenants
         return state
 
     def _fence(self, term: int) -> None:
@@ -566,19 +752,31 @@ class IndexServer:
                 return False
             self.term = int(self.term) + 1
             self.role = "primary"
-            rs = self._reshard
-            if rs is not None and rs.get("phase") == "drain":
-                # every lease is vacant on the promoted peer: put each
-                # un-drained participant on the membership_timeout clock
-                # so one that never fails over cannot deadlock the drain
-                now = self._clock()
-                for r in rs["targets"]:
-                    if r not in rs["drained"] and r not in rs["dead"]:
-                        self._vacated.setdefault(r, now)
+            self._promote_local_state_locked()
+            for eng in self._tenant_by_id.values():
+                # tenants promote with their front: same term, and their
+                # own in-flight drains go on the vacancy clock too
+                with eng._lock:
+                    eng.role = "primary"
+                    eng.term = self.term
+                    eng._promote_local_state_locked()
             self.metrics.inc("promotions")
             term = self.term
         telemetry.event("promoted", term=term)
         return True
+
+    def _promote_local_state_locked(self) -> None:
+        """Post-promotion bookkeeping shared by the front server and its
+        tenant engines: every lease is vacant on the promoted peer, so
+        each un-drained participant of an in-flight barrier goes on the
+        membership_timeout clock (one that never fails over must not
+        deadlock the drain)."""
+        rs = self._reshard
+        if rs is not None and rs.get("phase") == "drain":
+            now = self._clock()
+            for r in rs["targets"]:
+                if r not in rs["drained"] and r not in rs["dead"]:
+                    self._vacated.setdefault(r, now)
 
     def _standby_refusal(self) -> dict:
         with self._lock:
@@ -678,8 +876,21 @@ class IndexServer:
             }
         else:
             self._reshard = None
+        for tid, tstate in (state.get("tenants") or {}).items():
+            self._apply_tenant_state_locked(str(tid), dict(tstate))
 
     def _apply_record_locked(self, rec: dict) -> None:
+        tid = rec.get("tenant")
+        if tid is not None and rec.get("op") != "tenant":
+            # a tenant engine's record: route it to this side's mirror
+            # of that tenant (tag stripped — the engine's own handlers
+            # key on rank/epoch only)
+            eng = self._tenant_by_id.get(str(tid))
+            if eng is not None and eng is not self:
+                with eng._lock:
+                    eng._apply_record_locked(
+                        {k: v for k, v in rec.items() if k != "tenant"})
+            return
         op = rec.get("op")
         if op == "epoch":
             self.epoch = int(rec["epoch"])
@@ -703,6 +914,11 @@ class IndexServer:
             self._apply_state_locked(rec.get("state") or {})
         elif op == "seal":
             self._seal_pending = True
+        elif op == "tenant":
+            # tenant creation on the primary: mirror the full engine
+            # state (spec wire included) so failover restores it
+            self._apply_tenant_state_locked(
+                str(rec.get("tenant")), dict(rec.get("state") or {}))
         # unknown ops fall through: the record vocabulary is additive
 
     def _on_repl_sync(self, sock, header) -> None:
@@ -753,8 +969,15 @@ class IndexServer:
                 self._applied_lsn = lsn
             applied = self._applied_lsn
             seal, self._seal_pending = self._seal_pending, False
+            sealed = []
+            for eng in self._tenant_by_id.values():
+                if eng._seal_pending:
+                    eng._seal_pending = False
+                    sealed.append(eng)
         if seal:
             self._write_snapshot(force=True)
+        for eng in sealed:
+            eng._write_snapshot(force=True)
         P.send_msg(sock, P.MSG_OK, {"applied_lsn": applied})
 
     def _on_repl_promote(self, sock, header) -> None:
@@ -804,19 +1027,32 @@ class IndexServer:
             if arr is not None:
                 self._cache.move_to_end(key)
                 return arr
-            t0 = time.perf_counter()
-            with _span("server.epoch_regen", epoch=int(epoch),
-                       rank=int(rank), generation=gen):
-                with self.metrics.regen_timer.measure():
-                    arr = np.asarray(spec.rank_indices(epoch, rank,
-                                                       layers=layers))
-                    if orphans:
-                        # dead ranks' un-drained allocations ride as a
-                        # prefix of rank 0's stream — every index still
-                        # served once
-                        parts = [self._orphan_slice(spec, o)
-                                 for o in orphans]
-                        arr = np.concatenate(parts + [arr])
+            # cache miss → real regen work: multi-tenant daemons run it
+            # through the fair-share queue so one tenant's huge regen
+            # cannot starve another's (cache hits never queue, and a
+            # single-tenant daemon has no scheduler — zero new cost)
+            sched = self._regen_sched
+            slot = (sched.slot(self.tenant_id, cost=self._regen_cost(),
+                               clock=time.perf_counter)
+                    if sched is not None else nullcontext())
+            extra = ({"tenant": self.tenant_id} if sched is not None
+                     else {})
+            with slot:
+                # t0 after the queue wait: epoch_regen_ms stays a pure
+                # regen timing (queue time lands in regen_queue_ms)
+                t0 = time.perf_counter()
+                with _span("server.epoch_regen", epoch=int(epoch),
+                           rank=int(rank), generation=gen, **extra):
+                    with self.metrics.regen_timer.measure():
+                        arr = np.asarray(spec.rank_indices(epoch, rank,
+                                                           layers=layers))
+                        if orphans:
+                            # dead ranks' un-drained allocations ride as
+                            # a prefix of rank 0's stream — every index
+                            # still served once
+                            parts = [self._orphan_slice(spec, o)
+                                     for o in orphans]
+                            arr = np.concatenate(parts + [arr])
             self.metrics.registry.histogram("epoch_regen_ms").observe(
                 (time.perf_counter() - t0) * 1e3)
             arr.setflags(write=False)
@@ -882,6 +1118,10 @@ class IndexServer:
             except OSError:
                 pass
         self._sweep_membership(now)
+        for eng in self._engines():
+            # tenant engines have no accept loop of their own; the front
+            # server's tick drives their eviction and membership sweeps
+            eng._sweep_leases()
 
     def _sweep_membership(self, now: float) -> None:
         """Elastic liveness, on the accept-loop tick: convert dead drain
@@ -961,19 +1201,23 @@ class IndexServer:
                         pass
                     return
                 t0 = time.perf_counter()
+                eng = (self._conn_tenant.get(conn_id, self)
+                       if self.multi_tenant else self)
+                extra = {"tenant": eng.tenant_id} if self.multi_tenant \
+                    else {}
                 try:
                     # the span wraps the fault-injection point too, so a
                     # dump triggered by an injected dispatch fault shows
                     # the request being served when it fired
                     with _span("server." + P.msg_name(msg),
                                trace=header.get("trace"), conn=conn_id,
-                               rank=header.get("rank")):
+                               rank=header.get("rank"), **extra):
                         F.fire("server.dispatch")
                         self._dispatch(sock, conn_id, msg, header, payload)
                 except OSError:
                     return  # peer vanished mid-reply
                 if msg == P.MSG_GET_BATCH:
-                    self.metrics.registry.histogram(
+                    eng.metrics.registry.histogram(
                         "batch_service_ms"
                     ).observe((time.perf_counter() - t0) * 1e3)
         except (ConnectionError, OSError):
@@ -981,6 +1225,9 @@ class IndexServer:
         except F.InjectedThreadDeath:
             return  # injected serve-thread death; cleanup below still runs
         finally:
+            teng = self._conn_tenant.pop(conn_id, None)
+            if teng is not None:
+                teng._release_conn(conn_id)
             self._release_conn(conn_id)
             try:
                 sock.close()
@@ -1039,40 +1286,63 @@ class IndexServer:
                 _annotate(error_code="fenced")
                 P.send_msg(sock, P.MSG_ERROR, refusal)
                 return
+        # tenant routing: the connection's HELLO binding wins; an
+        # explicit additive ``tenant`` header field (mirroring ``trace``)
+        # can name the namespace when a connection serves ops traffic
+        engine = self
+        if self._conn_tenant or self._tenant_by_id:
+            engine = self._conn_tenant.get(conn_id, self)
+            tid = header.get("tenant")
+            if tid is not None:
+                engine = self._tenant_by_id.get(str(tid), engine)
         if msg == P.MSG_HELLO:
             self._on_hello(sock, conn_id, header)
         elif msg == P.MSG_GET_BATCH:
-            self._on_get_batch(sock, conn_id, header)
+            engine._on_get_batch(sock, conn_id, header)
         elif msg == P.MSG_SET_EPOCH:
-            with self._lock:
-                self.epoch = int(header.get("epoch", 0))
-                self._repl_append("epoch", epoch=self.epoch)
-            self._write_snapshot(force=True)
-            P.send_msg(sock, P.MSG_OK, {"epoch": self.epoch})
+            engine._on_set_epoch(sock, header)
         elif msg == P.MSG_HEARTBEAT:
-            self._on_heartbeat(sock, conn_id, header)
+            engine._on_heartbeat(sock, conn_id, header)
         elif msg == P.MSG_SNAPSHOT:
-            self._write_snapshot(force=True)
+            engine._write_snapshot(force=True)
             P.send_msg(sock, P.MSG_SNAPSHOT_STATE,
-                       {"state": self._state_dict()})
+                       {"state": engine._state_dict()})
         elif msg == P.MSG_METRICS:
+            # a tenant-bound connection reads its own scoped report —
+            # isolation; the front's report carries the tenant rollup
             P.send_msg(sock, P.MSG_METRICS_REPORT,
-                       {"report": self.metrics.report()})
+                       {"report": engine.metrics.report()})
         elif msg == P.MSG_LEAVE:
-            self._on_leave(sock, conn_id, header)
+            engine._on_leave(sock, conn_id, header)
         elif msg == P.MSG_RESHARD:
-            self._on_reshard(sock, conn_id, header)
+            engine._on_reshard(sock, conn_id, header)
         elif msg == P.MSG_TRACE_DUMP:
             limit = int(header.get("limit", 256))
+            entries = telemetry.snapshot(limit)
+            if self.multi_tenant:
+                # trace isolation: tenant-tagged spans of OTHER tenants
+                # never leak into this connection's dump (untagged
+                # entries are shared-infrastructure and stay visible)
+                own = engine.tenant_id
+                entries = [e for e in entries
+                           if (e.get("attrs") or {}).get("tenant")
+                           in (None, own)]
             P.send_msg(sock, P.MSG_TRACE_REPORT, {
                 "enabled": telemetry.enabled(),
-                "entries": telemetry.snapshot(limit),
+                "entries": entries,
             })
         else:
             P.send_msg(sock, P.MSG_ERROR, {
                 "code": "unknown_type",
                 "detail": f"message type {P.msg_name(msg)} not served",
             })
+
+    def _on_set_epoch(self, sock, header) -> None:
+        with self._lock:
+            self.epoch = int(header.get("epoch", 0))
+            self._repl_append("epoch", epoch=self.epoch)
+        self._write_snapshot(force=True)
+        P.send_msg(sock, P.MSG_OK, {"epoch": self.epoch})
 
     def _on_heartbeat(self, sock, conn_id, header) -> None:
         """Keepalive, optionally carrying the client's delivered-ack
@@ -1466,23 +1736,128 @@ class IndexServer:
                           f"client sent {proto!r}",
             })
             return
+        engine = self._route_hello(sock, header)
+        if engine is None:
+            return  # refusal already sent
+        if engine is not self:
+            # bind the connection to its tenant: subsequent frames route
+            # without re-stating the namespace, and the engine's sweeps
+            # can close the socket it leases ranks to
+            with self._lock:
+                self._conn_tenant[conn_id] = engine
+            with engine._lock:
+                engine._conn_socks[conn_id] = sock
+            _annotate(tenant=engine.tenant_id)
+        engine._hello_claim(sock, conn_id, header)
+
+    def _route_hello(self, sock, header) -> Optional["IndexServer"]:
+        """Resolve a HELLO's namespace (docs/SERVICE.md "Tenancy"): no
+        fingerprint or our own → the default tenant (this server), a
+        known tenant fingerprint → its engine, an unknown one → admission
+        (create the tenant) on a multi-tenant daemon, or the typed
+        ``spec_mismatch`` refusal carrying both world-stripped
+        fingerprints.  Returns the engine, or None after refusing."""
+        fp = header.get("spec_fingerprint")
+        ours = self.spec.fingerprint(include_world=False)
+        if fp is None or fp == ours:
+            return self
+        eng = self._tenants.get(fp)
+        if eng is not None:
+            return eng
+        wire = header.get("spec")
+        if not self.multi_tenant or wire is None:
+            detail = (
+                "client and server stream specs differ; refusing to serve "
+                "a different permutation than requested (this daemon is "
+                "single-tenant)" if not self.multi_tenant else
+                "unknown tenant fingerprint and the HELLO carried no "
+                "'spec' wire form to create the tenant from"
+            )
+            _annotate(error_code="spec_mismatch")
+            P.send_msg(sock, P.MSG_ERROR, {
+                "code": "spec_mismatch",
+                "server_fingerprint": ours,
+                "client_fingerprint": fp,
+                "detail": detail,
+            })
+            return None
+        return self._admit_tenant(sock, fp, wire)
+
+    def _admit_tenant(self, sock, fp, wire) -> Optional["IndexServer"]:
+        """Create-or-attach for an unknown tenant fingerprint.  The
+        ``tenant.admission`` fault site fires before any state changes,
+        so an injected fault is a clean retryable refusal; capacity
+        refusals are terminal ``spec_mismatch`` (carrying both
+        fingerprints), transient ones are ``tenant_admission`` with the
+        typed ``retry_ms`` backpressure."""
+        try:
+            F.fire("tenant.admission")
+        except F.InjectedThreadDeath:
+            raise
+        except Exception as exc:
+            self.metrics.inc("tenant_admission_rejects")
+            _annotate(error_code="tenant_admission")
+            P.send_msg(sock, P.MSG_ERROR, {
+                "code": "tenant_admission", "retry_ms": 50,
+                "detail": f"tenant admission refused ({exc!r}); retry",
+            })
+            return None
+        try:
+            spec = PartialShuffleSpec.from_wire(
+                dict(wire), backend=self.spec.backend)
+        except (TypeError, ValueError, KeyError) as exc:
+            P.send_msg(sock, P.MSG_ERROR, {
+                "code": "bad_request",
+                "detail": f"HELLO 'spec' wire form did not parse: {exc!r}",
+            })
+            return None
+        if spec.fingerprint(include_world=False) != fp:
+            _annotate(error_code="spec_mismatch")
+            P.send_msg(sock, P.MSG_ERROR, {
+                "code": "spec_mismatch",
+                "server_fingerprint": spec.fingerprint(include_world=False),
+                "client_fingerprint": fp,
+                "detail": "HELLO 'spec' wire form does not match the "
+                          "declared fingerprint",
+            })
+            return None
+        eng = self._make_tenant_engine(spec)
+        with self._lock:
+            cur = self._tenants.get(fp)
+            if cur is not None:
+                return cur  # concurrent creation: first registration wins
+            if len(self._tenants) + 2 > self.max_tenants:
+                # +2: the default tenant plus the one being created
+                self.metrics.inc("tenant_admission_rejects")
+                _annotate(error_code="spec_mismatch")
+                P.send_msg(sock, P.MSG_ERROR, {
+                    "code": "spec_mismatch",
+                    "server_fingerprint":
+                        self.spec.fingerprint(include_world=False),
+                    "client_fingerprint": fp,
+                    "tenants": len(self._tenants) + 1,
+                    "max_tenants": self.max_tenants,
+                    "detail": f"tenant capacity exceeded: this daemon "
+                              f"serves {len(self._tenants) + 1} of "
+                              f"{self.max_tenants} namespaces",
+                })
+                return None
+            self._register_tenant_locked(fp, eng)
+        self.metrics.inc("tenants_created")
+        telemetry.event("tenant_created", tenant=eng.tenant_id)
+        # replicate the creation with the engine's full state so a
+        # standby can mirror the tenant before any of its records arrive
+        self._repl_append("tenant", tenant=eng.tenant_id,
+                          state=eng._state_dict())
+        return eng
+
+    def _hello_claim(self, sock, conn_id, header) -> None:
         world = header.get("world")
         if world is not None and int(world) != self.spec.world:
             P.send_msg(sock, P.MSG_ERROR, {
                 "code": "world",
                 "detail": f"server world is {self.spec.world}, client "
                           f"expects {world}",
-            })
-            return
-        fp = header.get("spec_fingerprint")
-        if fp is not None and \
-                fp != self.spec.fingerprint(include_world=False):
-            # membership-aware identity: the world is authoritative server
-            # state once resharding exists, so peers compare it stripped
-            P.send_msg(sock, P.MSG_ERROR, {
-                "code": "spec",
-                "detail": "client and server stream specs differ; refusing "
-                          "to serve a different permutation than requested",
             })
             return
         batch = int(header.get("batch", 0))
@@ -1494,7 +1869,27 @@ class IndexServer:
         want = header.get("rank", -1)
         want = -1 if want is None else int(want)
         now = self._clock()
+        front = self._parent if self._parent is not None else self
         with self._lock:
+            q = self.quota
+            if q is not None and q.max_ranks is not None:
+                live = sum(
+                    1 for r, l in self._leases.items()
+                    if l.get("owner") is not None
+                    and l.get("owner") != conn_id
+                    and now - l["last_seen"] <= self.heartbeat_timeout)
+                if live >= q.max_ranks:
+                    # admission control: retryable — a lease may free
+                    self.metrics.inc("tenant_admission_rejects")
+                    _annotate(error_code="tenant_admission")
+                    P.send_msg(sock, P.MSG_ERROR, {
+                        "code": "tenant_admission", "retry_ms": 100,
+                        "tenant": self.tenant_id,
+                        "detail": f"tenant {self.tenant_id} holds {live} "
+                                  f"live rank leases; quota max_ranks="
+                                  f"{q.max_ranks}",
+                    })
+                    return
             if want >= self.spec.world and self.generation > 0:
                 # a pre-reshard client coming back for a rank the commit
                 # removed: tell it the world changed rather than "no_rank"
@@ -1521,9 +1916,13 @@ class IndexServer:
                 "proto": P.PROTOCOL_VERSION,
                 "rank": rank,
                 "spec": self.spec.to_wire(),
-                "term": int(self.term),
-                "standby": (list(self._standby_addr)
-                            if self._standby_addr is not None else None),
+                # term/standby are front-server facts: a tenant's client
+                # fails over to the DAEMON's standby, which mirrors every
+                # tenant (additive field, like ``trace`` in PR 4)
+                "tenant": self.tenant_id,
+                "term": int(front.term),
+                "standby": (list(front._standby_addr)
+                            if front._standby_addr is not None else None),
                 **self._membership_locked(),
             }
         self._write_snapshot()

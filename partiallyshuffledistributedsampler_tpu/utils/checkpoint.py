@@ -44,3 +44,34 @@ def save_sampler_state(path: str, state: dict, *, durable: bool = False) -> None
 def load_sampler_state(path: str) -> dict:
     with open(path) as f:
         return json.load(f)
+
+
+def tenant_snapshot_path(path: str, tenant_id: str) -> str:
+    """Per-tenant sibling of a daemon snapshot path.
+
+    ``/var/psds/snap.json`` + tenant ``t0a1b2c3d4`` →
+    ``/var/psds/snap.tenant-t0a1b2c3d4.json`` — the multi-tenant daemon
+    (docs/SERVICE.md "Tenancy") writes one snapshot per tenant next to
+    its own, and rediscovers them with :func:`list_tenant_snapshots` on
+    restart."""
+    root, ext = os.path.splitext(path)
+    return f"{root}.tenant-{tenant_id}{ext or '.json'}"
+
+
+def list_tenant_snapshots(path: str) -> dict:
+    """Map of ``tenant_id -> snapshot path`` for tenants saved next to
+    the base snapshot ``path`` (inverse of :func:`tenant_snapshot_path`)."""
+    root, ext = os.path.splitext(path)
+    ext = ext or ".json"
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    if not os.path.isdir(d):
+        return {}
+    prefix = os.path.basename(root) + ".tenant-"
+    out = {}
+    for name in sorted(os.listdir(d)):
+        if not (name.startswith(prefix) and name.endswith(ext)):
+            continue
+        tid = name[len(prefix):len(name) - len(ext)]
+        if tid and "." not in tid:
+            out[tid] = os.path.join(d, name)
+    return out

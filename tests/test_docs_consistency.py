@@ -137,3 +137,25 @@ def test_observability_doc_cross_linked():
     # the protocol table documents the telemetry RPC pair
     svc = (DOCS / "SERVICE.md").read_text()
     assert "TRACE_DUMP" in svc and "TRACE_REPORT" in svc
+
+
+def test_tenancy_doc_cross_linked():
+    """The multi-tenant surface is documented where an operator would
+    look: SERVICE.md owns the namespace/quota/fair-share story (with
+    both wire codes), API.md documents the knobs, OBSERVABILITY.md the
+    per-tenant metric names."""
+    svc = (DOCS / "SERVICE.md").read_text()
+    assert "## Tenancy" in svc, "docs/SERVICE.md lost its Tenancy section"
+    for token in ("spec_mismatch", "tenant_admission", "max_tenants",
+                  "tenants_created", "tenancy-smoke"):
+        assert token in svc, f"docs/SERVICE.md Tenancy lost `{token}`"
+    api = API_MD.read_text()
+    for token in ("multi_tenant=False", "TenantQuota", "FairShareScheduler",
+                  "SpecMismatchError"):
+        assert token in api, f"docs/API.md lost the tenancy surface `{token}`"
+    obs = OBSERVABILITY_MD.read_text()
+    for token in ("regen_queue_ms", "tenant_admission_rejects",
+                  "admission_waits"):
+        assert token in obs, (
+            f"docs/OBSERVABILITY.md lost the per-tenant metric `{token}`"
+        )

@@ -477,6 +477,17 @@ def test_fresh_autoclaim_refuses_partially_served_slot():
             got0.append(next(it0))  # c0's own ack commits; first
             # post-reshard batch arrives through the `resharded` adopt
             c0.close()  # lease freed, but the slot is partly served
+            # wait for the server to process c0's disconnect: until the
+            # lease release lands, the resharded header c1 is about to
+            # draw reflects a still-live slot 0 and the auto-claim path
+            # under test never runs
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                with srv._lock:
+                    lease = srv._leases.get(0)
+                    if lease is None or lease.get("owner") is None:
+                        break
+                time.sleep(0.01)
             rest1 = list(it1)  # displaced; the only slot is not adoptable
             assert rest1 == []
             assert c1.rank is None

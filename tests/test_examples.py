@@ -50,6 +50,17 @@ def test_llama3_10b_index_example():
     assert "ok: config-5 shape end to end" in out
 
 
+def test_multi_tenant_example():
+    # same platform pinning as the service example: the tenancy story is
+    # pure host/wire behavior
+    out = run_example("multi_tenant_example.py", {"JAX_PLATFORMS": "cpu"},
+                      timeout=180)
+    assert "2 namespaces: both streams bit-identical" in out
+    assert "then streamed exactly" in out
+    assert "fair-share queue, streams exact" in out
+    assert "ok: multi-tenant service end to end" in out
+
+
 def test_index_service_example():
     # pin the CPU platform: the service/loader parity is platform-free and
     # the emulated-TPU tunnel makes the per-batch device_puts crawl

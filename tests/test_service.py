@@ -172,7 +172,12 @@ def test_spec_fingerprint_mismatch_refused():
         c = ServiceIndexClient(srv.address, spec=other, reconnect_timeout=1.0)
         with pytest.raises(ServiceError) as ei:
             c._ensure_connected()
-        assert ei.value.code == "spec"
+        # typed refusal carrying both world-stripped fingerprints
+        assert ei.value.code == "spec_mismatch"
+        assert ei.value.header["server_fingerprint"] == \
+            spec.fingerprint(include_world=False)
+        assert ei.value.header["client_fingerprint"] == \
+            other.fingerprint(include_world=False)
 
 
 # --------------------------------------------------- backpressure + leases
