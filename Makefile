@@ -42,16 +42,25 @@
 #                          namespaces: two-tenant bit-identity, fair-
 #                          share starvation bound, admission quotas,
 #                          then the co-residency-within-noise bar
+#   * analyze              project-native static analysis (docs/ANALYSIS.md):
+#                          guarded-by discipline, fault-site/protocol/
+#                          metrics-docs drift, clock discipline, silent-
+#                          except audit — non-zero exit on any finding
+#   * analysis smoke       tests/test_analysis.py + the same suite under
+#                          PSDS_SANITIZE=1 (lock-order + thread-leak
+#                          gates live), then benchmarks/analysis_smoke.py
+#                          — sanitizer-overhead-within-noise bar
 
 PY ?= python
 
 .PHONY: check test bench native dryrun service-smoke chaos-smoke \
-	elastic-smoke telemetry-smoke failover-smoke tenancy-smoke
+	elastic-smoke telemetry-smoke failover-smoke tenancy-smoke \
+	analyze analysis-smoke
 
 # the driver parses the LAST line of bench.py's combined output (round 3
 # lost its headline to the details line — BENCH_r03.json "parsed": null),
 # so the gate replicates that read and asserts it yields the metric
-check: test dryrun service-smoke
+check: analyze test dryrun service-smoke
 	PSDS_BENCH_SMOKE=1 $(PY) bench.py >.bench_smoke.out 2>&1 \
 		|| { cat .bench_smoke.out; exit 1; }
 	@cat .bench_smoke.out
@@ -108,6 +117,20 @@ failover-smoke:
 tenancy-smoke:
 	$(PY) -m pytest tests/test_tenancy.py -q -m tenancy -ra
 	$(PY) benchmarks/tenancy_smoke.py
+
+# static-analysis gate (docs/ANALYSIS.md): every lint pass over the
+# package + docs; any finding is a non-zero exit with file:line output
+analyze:
+	$(PY) -m partiallyshuffledistributedsampler_tpu.analysis
+
+# concurrency-sanitizer gate: the lint/sanitizer self-tests (golden
+# files, deliberate lock inversion, thread-leak detector), the service-
+# facing suites re-run with lock tracking live, then the overhead bar
+analysis-smoke:
+	$(PY) -m pytest tests/test_analysis.py -q -ra
+	PSDS_SANITIZE=1 $(PY) -m pytest tests/test_analysis.py \
+		tests/test_service.py -q -ra
+	$(PY) benchmarks/analysis_smoke.py
 
 # observability gate (docs/OBSERVABILITY.md): trace propagation across
 # the hard paths (reshard refusal, degraded fallback, injected dispatch

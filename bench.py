@@ -420,6 +420,21 @@ def main() -> None:
         except Exception as exc:
             details["tenancy_error"] = repr(exc)[:200]
 
+    # detail tier: analysis — concurrency-sanitizer overhead: the
+    # tracked-lock arm must stay within the raw-lock arm's rep noise
+    # and record zero lock-order cycles (methodology in
+    # benchmarks/analysis_smoke.py)
+    if not smoke:
+        try:
+            sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+            from benchmarks.analysis_smoke import (
+                summarize as analysis_summarize,
+            )
+
+            details["analysis"] = analysis_summarize()
+        except Exception as exc:
+            details["analysis_error"] = repr(exc)[:200]
+
     # regression tripwire: any ``*within_noise`` flag that was true in
     # the previous recorded round and is false now gets a loud line —
     # a perf regression must never slip through as a silently-flipped

@@ -1,0 +1,123 @@
+"""Concurrency-sanitizer overhead smoke: tracking must cost only noise.
+
+Two consumers:
+
+* ``python benchmarks/analysis_smoke.py`` — the CI gate: serve the same
+  epoch stream with the lock-order sanitizer off (raw ``threading.Lock``
+  from ``new_lock``) and on (``TrackedLock`` + acquisition graph), and
+  assert the on-arm wall per step stays within the off arm's own
+  rep-to-rep noise, the served streams are bit-identical, and the drill
+  records zero lock-order violations.  Exit 0 and one JSON line on
+  success; raises loudly otherwise.
+
+* ``bench.py`` imports :func:`summarize` for ``details["analysis"]``.
+
+Methodology: the lock flavor is fixed at *creation* (``new_lock`` checks
+the flag once), so each rep builds a fresh ``IndexServer`` + client
+under the arm's mode and streams one epoch.  Arms alternate so drift
+hits both equally.  The noise floor is the off arm's max−min across reps
+with a small absolute floor — the claim is "the sanitizer disappears
+into run-to-run variance when off, and stays within that variance when
+on", not a fixed microsecond budget (docs/ANALYSIS.md).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+#: a quiet machine's rep spread can be ~0; the bar still needs slack for
+#: scheduler jitter between the two arms (ms per GET_BATCH step)
+_NOISE_FLOOR_MS_PER_STEP = 0.05
+
+
+def _epoch_wall_ms(spec, batch: int):
+    """Build a fresh server under the CURRENT sanitizer mode, stream one
+    epoch, tear down.  Returns (wall ms, served array)."""
+    from partiallyshuffledistributedsampler_tpu.service import (
+        IndexServer,
+        ServiceIndexClient,
+    )
+
+    with IndexServer(spec) as srv:
+        with ServiceIndexClient(srv.address, rank=0, batch=batch) as c:
+            t0 = time.perf_counter()
+            got = np.concatenate(list(c.epoch_batches(1)))
+            ms = (time.perf_counter() - t0) * 1e3
+    return ms, got
+
+
+def summarize(*, n: int = 50_000, window: int = 256, batch: int = 256,
+              reps: int = 5) -> dict:
+    """Sanitizer-off vs sanitizer-on served epoch wall per step — the
+    ``details["analysis"]`` tier."""
+    from partiallyshuffledistributedsampler_tpu.analysis import lockorder
+    from partiallyshuffledistributedsampler_tpu.service import (
+        PartialShuffleSpec,
+    )
+
+    spec = PartialShuffleSpec.plain(n, window=window, seed=0, world=1)
+    ref = np.asarray(spec.rank_indices(1, 0))
+    steps = -(-n // batch)
+    prior = lockorder.is_enabled()
+    off_ms, on_ms = [], []
+    try:
+        # one unmeasured warm-up per arm: first-build costs (import, page
+        # cache, thread spawn, allocator growth) must not land in a
+        # measured rep of whichever arm happens to run first
+        lockorder.disable()
+        _epoch_wall_ms(spec, batch)
+        lockorder.enable()
+        _epoch_wall_ms(spec, batch)
+        for _ in range(reps):
+            lockorder.disable()
+            ms, got_off = _epoch_wall_ms(spec, batch)
+            off_ms.append(ms)
+            lockorder.enable()
+            ms, got_on = _epoch_wall_ms(spec, batch)
+            on_ms.append(ms)
+        if not (np.array_equal(got_off, ref)
+                and np.array_equal(got_on, ref)):
+            raise AssertionError(
+                "served stream changed under the sanitizer — lock "
+                "tracking must never touch the data")
+        violations = len(lockorder.violations())
+    finally:
+        lockorder.reset()
+        if prior:
+            lockorder.enable()
+        else:
+            lockorder.disable()
+    noise = max((max(off_ms) - min(off_ms)) / steps,
+                _NOISE_FLOOR_MS_PER_STEP)
+    off_med, on_med = float(np.median(off_ms)), float(np.median(on_ms))
+    return {
+        "n": n, "batch": batch, "steps": steps, "reps": reps,
+        "off_ms_per_step": round(off_med / steps, 5),
+        "on_ms_per_step": round(on_med / steps, 5),
+        "overhead_ms_per_step": round((on_med - off_med) / steps, 5),
+        "steady_noise_ms_per_step": round(noise, 5),
+        "lockorder_violations": violations,
+        "sanitize_overhead_within_noise": bool(
+            (on_med - off_med) / steps <= noise),
+    }
+
+
+def main() -> int:
+    out = summarize()
+    print(json.dumps(out, sort_keys=True))
+    assert out["lockorder_violations"] == 0, (
+        "the served-index drill recorded lock-order cycles: %r" % (out,))
+    assert out["sanitize_overhead_within_noise"], (
+        "sanitizer-on arm exceeded the off arm's noise: %r" % (out,))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

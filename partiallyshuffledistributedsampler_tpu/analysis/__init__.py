@@ -1,0 +1,29 @@
+"""Project-native static analysis + runtime concurrency sanitizer.
+
+Two halves (docs/ANALYSIS.md):
+
+* :mod:`.lint` — AST-based, dependency-free static passes run over the
+  package source by ``python -m partiallyshuffledistributedsampler_tpu.analysis``
+  (and by ``make analyze`` / ``tests/test_analysis.py``): guarded-by
+  discipline, fault-site registry drift, protocol exhaustiveness, clock
+  discipline, silent-except audit, and metrics/docs drift.
+* :mod:`.lockorder` — an instrumented lock factory with a process-wide
+  lock-acquisition-order graph (potential-deadlock cycle reports naming
+  both acquisition stacks) plus a thread-leak detector, enabled under
+  ``PSDS_SANITIZE=1`` and zero-cost when off (``new_lock`` hands back a
+  raw ``threading.Lock`` after one flag check — the sanitizer's analogue
+  of the tracer's ``NULL_SPAN``).
+
+Both halves import nothing from the rest of the package (and nothing
+beyond the stdlib), so every layer can create its locks through
+:func:`~.lockorder.new_lock` without import cycles and the lint CLI
+never needs jax to run.
+"""
+
+from __future__ import annotations
+
+from . import lockorder  # noqa: F401  (re-exported submodule)
+from .lint import Finding, run_all  # noqa: F401
+from .lockorder import new_lock  # noqa: F401
+
+__all__ = ["Finding", "run_all", "lockorder", "new_lock"]

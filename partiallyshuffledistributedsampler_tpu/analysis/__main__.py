@@ -1,0 +1,47 @@
+"""CLI: run every lint pass over the repo, non-zero exit on findings.
+
+    python -m partiallyshuffledistributedsampler_tpu.analysis
+    python -m partiallyshuffledistributedsampler_tpu.analysis --pass guarded-by
+    python -m partiallyshuffledistributedsampler_tpu.analysis --json
+
+``make analyze`` runs this with no arguments as a CI gate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from .lint import PASSES, default_root, run_all
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m partiallyshuffledistributedsampler_tpu.analysis",
+        description="project-native static analysis (docs/ANALYSIS.md)")
+    ap.add_argument("--root", type=Path, default=None,
+                    help="repo root (default: auto-detected)")
+    ap.add_argument("--pass", dest="passes", action="append",
+                    choices=sorted(PASSES), default=None,
+                    help="run only this pass (repeatable; default: all)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable findings on stdout")
+    args = ap.parse_args(argv)
+
+    root = args.root if args.root is not None else default_root()
+    findings = run_all(root, args.passes)
+    if args.json:
+        print(json.dumps([f.__dict__ for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+        names = ", ".join(args.passes or sorted(PASSES))
+        print(f"analysis: {len(findings)} finding(s) "
+              f"[{names}] over {root}")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,615 @@
+"""AST-based static lint passes over the package source (docs/ANALYSIS.md).
+
+Six passes, each a pure function over source text — no imports of the
+checked code, no jax, no third-party dependencies, so the CLI
+(``python -m partiallyshuffledistributedsampler_tpu.analysis``) runs in
+milliseconds anywhere the repo checks out:
+
+* ``guarded-by``      — fields annotated ``# guarded by: self._lock``
+                        must only be touched inside ``with self._lock``
+                        (or the Condition built on it) in the same class.
+* ``fault-sites``     — ``faults.runtime.draw("site")`` literals and
+                        ``plan.SITES`` must agree in both directions.
+* ``protocol``        — every ``MSG_*`` opcode needs a server dispatch
+                        arm (or is a server-emitted reply), and every
+                        typed error code the server sends needs a
+                        client-side handler or documented passthrough.
+* ``clocks``          — modules that accept an injectable ``clock=``
+                        must not call ``time.time()``/``datetime.now()``.
+* ``silent-except``   — ``except Exception`` must re-raise, reference
+                        the exception, bump a metric, log a telemetry
+                        event, or carry a waiver.
+* ``metrics-docs``    — counter/timer/histogram names referenced by
+                        docs/*.md must exist in the code.
+
+Waiver syntax (a finding the repo has *decided* to live with must say
+why, on the flagged line)::
+
+    except Exception:  # lint: allow-broad-except(best-effort dlclose)
+    x = self._tenants  # lint: allow-unguarded(read-only race is benign)
+    t = time.time()    # lint: allow-wallclock(dump filenames are wall time)
+
+An empty reason is itself a finding.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Tuple
+
+__all__ = ["Finding", "run_all", "PASSES"]
+
+#: package directory name (the lints locate it under the repo root)
+_PKG = "partiallyshuffledistributedsampler_tpu"
+
+#: error codes the server sends that are deliberately *not* string-matched
+#: client-side: none today — every typed code has a handler or sits in the
+#: client's ``_FATAL_CODES``.  A future code that is documentation-only
+#: (surfaced verbatim through ``ServiceError.code``) belongs here, with
+#: the doc section that owns it.
+_ERROR_CODE_PASSTHROUGH: frozenset = frozenset()
+
+#: backticked snake_case doc tokens that *look* like metric names but are
+#: attribute/kwarg vocabulary, not registry entries (docs/ANALYSIS.md
+#: "metrics-docs"): extend this set when documenting a non-metric token
+#: inside a metrics paragraph.
+_DOC_TOKEN_PASSTHROUGH = frozenset({
+    # RegenTimer / Histogram / StallProbe report-field vocabulary
+    "samples_ms", "max_samples", "mean_ms", "last_ms", "epochs_timed",
+    "p50_ms", "p95_ms", "p99_ms", "max_ms", "stall_fraction",
+    # constructor kwargs documented in paragraphs that also mention the
+    # daemon's counters/histograms
+    "reconnect_timeout", "epoch_batches", "max_inflight",
+    "heartbeat_timeout", "max_cached_arrays", "snapshot_path",
+    "repl_feed_timeout", "max_tenants", "max_ranks", "regen_concurrency",
+    # wire-header fields from the protocol table (its METRICS row says
+    # "counters, timers, per-client")
+    "spec_fingerprint", "retry_ms", "grace_ms", "from_lsn",
+    # typed error codes documented next to the counters they bump
+    "tenant_admission", "spec_mismatch",
+    # smoke-report fields the docs quote next to the metric tables
+    "steady_noise_ms_per_step", "sanitize_overhead_within_noise",
+})
+
+
+@dataclass(frozen=True)
+class Finding:
+    pass_id: str
+    path: str
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.pass_id}] {self.message}"
+
+
+# --------------------------------------------------------------- utilities
+def _comments_by_line(source: str) -> Dict[int, str]:
+    """line number -> comment text (tokenized, so '#' in strings is not
+    mistaken for a comment)."""
+    out: Dict[int, str] = {}
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                out[tok.start[0]] = tok.string
+    except tokenize.TokenizeError:
+        pass
+    return out
+
+
+_WAIVER_RE = re.compile(r"#\s*lint:\s*allow-([a-z-]+)\(([^)]*)\)")
+
+
+def _waiver(comments: Dict[int, str], line: int, kind: str
+            ) -> Tuple[bool, Optional[str]]:
+    """(waived?, problem) — problem is set when the waiver has no reason."""
+    m = _WAIVER_RE.search(comments.get(line, ""))
+    if m is None or m.group(1) != kind:
+        return False, None
+    if not m.group(2).strip():
+        return False, f"waiver 'allow-{kind}' needs a reason"
+    return True, None
+
+
+def _parent_map(tree: ast.AST) -> Dict[ast.AST, ast.AST]:
+    parents: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """'x' when node is ``self.x``, else None."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _pkg_files(root: Path) -> List[Path]:
+    return sorted((root / _PKG).rglob("*.py"))
+
+
+def _read(path: Path) -> str:
+    return path.read_text(encoding="utf-8")
+
+
+# ---------------------------------------------------- pass: guarded-by (a)
+_GUARDED_RE = re.compile(r"#\s*guarded by:\s*self\.(\w+)")
+
+
+def check_guarded_by(source: str, path: str) -> List[Finding]:
+    """Fields declared ``# guarded by: self.<lock>`` on their ``__init__``
+    assignment must be accessed inside ``with self.<lock>`` (or a
+    ``threading.Condition`` built on that lock) in every other method of
+    the class.  Exemptions: ``__init__`` itself, methods whose name ends
+    ``_locked`` (the caller-holds-the-lock convention), and per-line
+    ``# lint: allow-unguarded(reason)`` waivers."""
+    findings: List[Finding] = []
+    tree = ast.parse(source)
+    comments = _comments_by_line(source)
+    parents = _parent_map(tree)
+
+    for cls in [n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)]:
+        guarded: Dict[str, str] = {}   # field -> lock attr
+        aliases: Dict[str, set] = {}   # lock attr -> {lock attr, cond attrs}
+        for fn in cls.body:
+            if not (isinstance(fn, ast.FunctionDef) and fn.name == "__init__"):
+                continue
+            for stmt in ast.walk(fn):
+                if not isinstance(stmt, ast.Assign):
+                    continue
+                targets = [t for t in stmt.targets
+                           if _self_attr(t) is not None]
+                if not targets:
+                    continue
+                field = _self_attr(targets[0])
+                m = _GUARDED_RE.search(comments.get(stmt.lineno, ""))
+                if m:
+                    guarded[field] = m.group(1)
+                # ``self._cond = threading.Condition(self._lock)``:
+                # holding the condition IS holding the lock
+                v = stmt.value
+                if (isinstance(v, ast.Call) and v.args
+                        and _self_attr(v.args[0]) is not None
+                        and ((isinstance(v.func, ast.Attribute)
+                              and v.func.attr == "Condition")
+                             or (isinstance(v.func, ast.Name)
+                                 and v.func.id == "Condition"))):
+                    aliases.setdefault(_self_attr(v.args[0]),
+                                       set()).add(field)
+        if not guarded:
+            continue
+        for lock in set(guarded.values()):
+            aliases.setdefault(lock, set()).add(lock)
+
+        def _holds(node: ast.AST, lock: str) -> bool:
+            cur = node
+            while cur is not None and cur is not cls:
+                if isinstance(cur, (ast.With, ast.AsyncWith)):
+                    for item in cur.items:
+                        ctx = item.context_expr
+                        # ``with self._lock:`` / ``with self._cond:``
+                        name = _self_attr(ctx)
+                        if name is None and isinstance(ctx, ast.Call):
+                            # tolerate ``with self._lock_held():`` helpers
+                            name = _self_attr(ctx.func)
+                        if name in aliases.get(lock, ()):
+                            return True
+                cur = parents.get(cur)
+            return False
+
+        for fn in [n for n in cls.body
+                   if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]:
+            if fn.name == "__init__" or fn.name.endswith("_locked"):
+                continue
+            for node in ast.walk(fn):
+                field = _self_attr(node)
+                if field not in guarded:
+                    continue
+                lock = guarded[field]
+                if _holds(node, lock):
+                    continue
+                waived, problem = _waiver(comments, node.lineno,
+                                          "unguarded")
+                if waived:
+                    continue
+                findings.append(Finding(
+                    "guarded-by", path, node.lineno,
+                    problem or (
+                        f"{cls.name}.{fn.name} touches self.{field} "
+                        f"(guarded by self.{lock}) outside 'with "
+                        f"self.{lock}'")))
+    return findings
+
+
+def lint_guarded_by(root: Path) -> List[Finding]:
+    findings: List[Finding] = []
+    for f in _pkg_files(root):
+        findings.extend(check_guarded_by(_read(f), str(f.relative_to(root))))
+    return findings
+
+
+# --------------------------------------------------- pass: fault-sites (b)
+def _plan_sites(plan_source: str) -> set:
+    tree = ast.parse(plan_source)
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Assign)
+                and any(isinstance(t, ast.Name) and t.id == "SITES"
+                        for t in node.targets)):
+            return {c.value for c in ast.walk(node.value)
+                    if isinstance(c, ast.Constant)
+                    and isinstance(c.value, str)}
+    return set()
+
+
+def _drawn_sites(source: str) -> Dict[str, int]:
+    """site literal -> first line where it is drawn/fired/passed."""
+    out: Dict[str, int] = {}
+    for node in ast.walk(ast.parse(source)):
+        if not isinstance(node, ast.Call):
+            continue
+        if (isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("draw", "fire")
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            out.setdefault(node.args[0].value, node.lineno)
+        for kw in node.keywords:
+            if (kw.arg == "site" and isinstance(kw.value, ast.Constant)
+                    and isinstance(kw.value.value, str)):
+                out.setdefault(kw.value.value, node.lineno)
+    return out
+
+
+def lint_fault_sites(root: Path) -> List[Finding]:
+    plan_path = root / _PKG / "faults" / "plan.py"
+    sites = _plan_sites(_read(plan_path))
+    findings: List[Finding] = []
+    used: Dict[str, Tuple[str, int]] = {}
+    for f in _pkg_files(root):
+        if f == plan_path:
+            continue
+        for site, line in _drawn_sites(_read(f)).items():
+            used.setdefault(site, (str(f.relative_to(root)), line))
+    for site, (path, line) in sorted(used.items()):
+        if site not in sites:
+            findings.append(Finding(
+                "fault-sites", path, line,
+                f"fault site {site!r} drawn here but absent from "
+                f"plan.SITES"))
+    for site in sorted(sites - set(used)):
+        findings.append(Finding(
+            "fault-sites", str(plan_path.relative_to(root)), 1,
+            f"plan.SITES registers {site!r} but no code draws it"))
+    return findings
+
+
+# ------------------------------------------------------ pass: protocol (c)
+def _msg_constants(proto_source: str) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for node in ast.walk(ast.parse(proto_source)):
+        if (isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id.startswith("MSG_")
+                and isinstance(node.value, ast.Constant)):
+            out[node.targets[0].id] = node.lineno
+    return out
+
+
+def _msg_refs(source: str) -> set:
+    refs = set()
+    for node in ast.walk(ast.parse(source)):
+        if isinstance(node, ast.Attribute) and node.attr.startswith("MSG_"):
+            refs.add(node.attr)
+        elif isinstance(node, ast.Name) and node.id.startswith("MSG_"):
+            refs.add(node.id)
+    return refs
+
+
+def _server_arms(server_source: str) -> Tuple[set, set]:
+    """(dispatched, emitted): opcodes compared against an incoming
+    message, and opcodes the server itself sends."""
+    dispatched, emitted = set(), set()
+    for node in ast.walk(ast.parse(server_source)):
+        if isinstance(node, ast.Compare):
+            for sub in ast.walk(node):
+                if (isinstance(sub, ast.Attribute)
+                        and sub.attr.startswith("MSG_")):
+                    dispatched.add(sub.attr)
+        elif (isinstance(node, ast.Call)
+              and isinstance(node.func, ast.Attribute)
+              and node.func.attr == "send_msg"
+              and len(node.args) >= 2
+              and isinstance(node.args[1], ast.Attribute)
+              and node.args[1].attr.startswith("MSG_")):
+            emitted.add(node.args[1].attr)
+    return dispatched, emitted
+
+
+def _sent_error_codes(server_source: str) -> Dict[str, int]:
+    """code literal -> line, from ``{"code": "..."}`` dict literals and
+    ``code = "..."`` / ``code = "a" if ... else "b"`` assignments."""
+    out: Dict[str, int] = {}
+
+    def _consts(v: ast.AST) -> Iterable[str]:
+        if isinstance(v, ast.Constant) and isinstance(v.value, str):
+            yield v.value
+        elif isinstance(v, ast.IfExp):
+            yield from _consts(v.body)
+            yield from _consts(v.orelse)
+
+    for node in ast.walk(ast.parse(server_source)):
+        if isinstance(node, ast.Dict):
+            for k, v in zip(node.keys, node.values):
+                if (isinstance(k, ast.Constant) and k.value == "code"):
+                    for code in _consts(v):
+                        out.setdefault(code, node.lineno)
+        elif (isinstance(node, ast.Assign)
+              and any(isinstance(t, ast.Name) and t.id == "code"
+                      for t in node.targets)):
+            for code in _consts(node.value):
+                out.setdefault(code, node.lineno)
+    return out
+
+
+def _str_constants(source: str) -> set:
+    return {n.value for n in ast.walk(ast.parse(source))
+            if isinstance(n, ast.Constant) and isinstance(n.value, str)}
+
+
+def lint_protocol(root: Path) -> List[Finding]:
+    svc = root / _PKG / "service"
+    proto_path, server_path = svc / "protocol.py", svc / "server.py"
+    msgs = _msg_constants(_read(proto_path))
+    server_src = _read(server_path)
+    dispatched, emitted = _server_arms(server_src)
+    findings: List[Finding] = []
+
+    refs: set = set()
+    for f in _pkg_files(root):
+        if f == proto_path:
+            continue
+        refs |= _msg_refs(_read(f))
+    rel_proto = str(proto_path.relative_to(root))
+    for name, line in sorted(msgs.items()):
+        if name not in refs:
+            findings.append(Finding(
+                "protocol", rel_proto, line,
+                f"opcode {name} is defined but never referenced outside "
+                f"protocol.py (dead opcode)"))
+        if name not in dispatched and name not in emitted:
+            findings.append(Finding(
+                "protocol", rel_proto, line,
+                f"opcode {name} has no server dispatch arm and is never "
+                f"emitted by the server"))
+
+    handled = (_str_constants(_read(svc / "client.py"))
+               | _str_constants(_read(svc / "replication.py"))
+               | _ERROR_CODE_PASSTHROUGH)
+    rel_server = str(server_path.relative_to(root))
+    for code, line in sorted(_sent_error_codes(server_src).items()):
+        if code not in handled:
+            findings.append(Finding(
+                "protocol", rel_server, line,
+                f"server sends ERROR code {code!r} but neither client.py "
+                f"nor replication.py handles it (add a handler or list it "
+                f"in _ERROR_CODE_PASSTHROUGH with its doc section)"))
+    return findings
+
+
+# -------------------------------------------------------- pass: clocks (d)
+def check_clocks(source: str, path: str) -> List[Finding]:
+    tree = ast.parse(source)
+    comments = _comments_by_line(source)
+    injectable = any(
+        isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        and any(a.arg == "clock" for a in
+                list(n.args.args) + list(n.args.kwonlyargs))
+        for n in ast.walk(tree))
+    if not injectable:
+        return []
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)):
+            continue
+        func = node.func
+        wall = (
+            (func.attr == "time" and isinstance(func.value, ast.Name)
+             and func.value.id == "time")
+            or (func.attr in ("now", "utcnow")
+                and ((isinstance(func.value, ast.Name)
+                      and func.value.id == "datetime")
+                     or (isinstance(func.value, ast.Attribute)
+                         and func.value.attr == "datetime"))))
+        if not wall:
+            continue
+        waived, problem = _waiver(comments, node.lineno, "wallclock")
+        if waived:
+            continue
+        findings.append(Finding(
+            "clocks", path, node.lineno,
+            problem or (
+                "raw wall-clock call in a module that accepts an "
+                "injectable clock= — route it through the injected "
+                "clock")))
+    return findings
+
+
+def lint_clocks(root: Path) -> List[Finding]:
+    findings: List[Finding] = []
+    for f in _pkg_files(root):
+        findings.extend(check_clocks(_read(f), str(f.relative_to(root))))
+    return findings
+
+
+# ------------------------------------------------- pass: silent-except (e)
+def check_silent_except(source: str, path: str) -> List[Finding]:
+    tree = ast.parse(source)
+    comments = _comments_by_line(source)
+    parents = _parent_map(tree)
+    findings: List[Finding] = []
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        broad = node.type is None or (
+            isinstance(node.type, ast.Name)
+            and node.type.id in ("Exception", "BaseException"))
+        if not broad:
+            continue
+        body_nodes = [n for stmt in node.body for n in ast.walk(stmt)]
+        # 1. re-raises (incl. a narrowed raise of a typed error)
+        if any(isinstance(n, ast.Raise) for n in body_nodes):
+            continue
+        # 2. the exception object is *used* — recorded, boxed, reported —
+        #    which is the opposite of silent
+        if node.name and any(
+                isinstance(n, ast.Name) and n.id == node.name
+                and isinstance(n.ctx, ast.Load) for n in body_nodes):
+            continue
+        # 3. a metric increment or telemetry event acknowledges it
+        if any(isinstance(n, ast.Call)
+               and isinstance(n.func, ast.Attribute)
+               and n.func.attr in ("inc", "event", "record", "auto_dump")
+               for n in body_nodes):
+            continue
+        if any(isinstance(n, ast.Call) and isinstance(n.func, ast.Name)
+               and n.func.id in ("auto_dump",) for n in body_nodes):
+            continue
+        # 4. import guards: ``try: import x`` with only imports (plus
+        #    flag assignments) in the try body is the canonical
+        #    optional-dependency probe
+        parent = parents.get(node)
+        if (isinstance(parent, ast.Try)
+                and any(isinstance(s, (ast.Import, ast.ImportFrom))
+                        for s in parent.body)
+                and all(isinstance(s, (ast.Import, ast.ImportFrom,
+                                       ast.Assign))
+                        for s in parent.body)):
+            continue
+        waived, problem = _waiver(comments, node.lineno, "broad-except")
+        if waived:
+            continue
+        findings.append(Finding(
+            "silent-except", path, node.lineno,
+            problem or (
+                "broad 'except Exception' swallows the error silently — "
+                "re-raise, bump a metric, log a telemetry event, or "
+                "waive with '# lint: allow-broad-except(reason)'")))
+    return findings
+
+
+def lint_silent_except(root: Path) -> List[Finding]:
+    findings: List[Finding] = []
+    for f in _pkg_files(root):
+        findings.extend(
+            check_silent_except(_read(f), str(f.relative_to(root))))
+    return findings
+
+
+# -------------------------------------------------- pass: metrics-docs (f)
+_DOC_TOKEN_RE = re.compile(r"`([a-z][a-z0-9]*(?:_[a-z0-9]+)+)`")
+_DOC_CONTEXT_RE = re.compile(
+    r"\b(counters?|timers?|histograms?)\b", re.IGNORECASE)
+
+
+def _code_metric_names(root: Path) -> set:
+    """Every literal name handed to ``.inc(...)`` / ``.timer(...)`` /
+    ``.histogram(...)`` anywhere in the package, plus the per-client
+    counter vocabulary tuple in service/metrics.py."""
+    names: set = set()
+    for f in _pkg_files(root):
+        tree = ast.parse(_read(f))
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("inc", "timer", "histogram")
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                names.add(node.args[0].value)
+            elif (isinstance(node, ast.Assign)
+                  and any(isinstance(t, ast.Name) and t.id == "_PER_CLIENT"
+                          for t in node.targets)):
+                names |= {c.value for c in ast.walk(node.value)
+                          if isinstance(c, ast.Constant)
+                          and isinstance(c.value, str)}
+    return names
+
+
+def doc_metric_tokens(text: str) -> Dict[str, int]:
+    """Backticked snake_case tokens inside metric-context paragraphs of
+    one markdown document, mapped to their line number."""
+    out: Dict[str, int] = {}
+    lines = text.splitlines()
+    para: List[Tuple[int, str]] = []
+
+    def _flush() -> None:
+        block = "\n".join(s for _, s in para)
+        if _DOC_CONTEXT_RE.search(block):
+            for lineno, s in para:
+                for m in _DOC_TOKEN_RE.finditer(s):
+                    out.setdefault(m.group(1), lineno)
+        para.clear()
+
+    for i, line in enumerate(lines, 1):
+        if line.strip():
+            para.append((i, line))
+        else:
+            _flush()
+    _flush()
+    return out
+
+
+def lint_metrics_docs(root: Path) -> List[Finding]:
+    known = _code_metric_names(root) | _DOC_TOKEN_PASSTHROUGH
+    findings: List[Finding] = []
+    for doc in sorted((root / "docs").glob("*.md")):
+        for token, line in sorted(doc_metric_tokens(_read(doc)).items()):
+            if token in known:
+                continue
+            findings.append(Finding(
+                "metrics-docs", str(doc.relative_to(root)), line,
+                f"docs reference metric-like name `{token}` but no code "
+                f"registers it (rename, or add to "
+                f"_DOC_TOKEN_PASSTHROUGH if it is not a metric)"))
+    return findings
+
+
+# ------------------------------------------------------------------ driver
+PASSES = {
+    "guarded-by": lint_guarded_by,
+    "fault-sites": lint_fault_sites,
+    "protocol": lint_protocol,
+    "clocks": lint_clocks,
+    "silent-except": lint_silent_except,
+    "metrics-docs": lint_metrics_docs,
+}
+
+
+def default_root() -> Path:
+    return Path(__file__).resolve().parents[2]
+
+
+def run_all(root: Optional[Path] = None,
+            passes: Optional[Iterable[str]] = None) -> List[Finding]:
+    root = Path(root) if root is not None else default_root()
+    selected = list(passes) if passes is not None else list(PASSES)
+    unknown = [p for p in selected if p not in PASSES]
+    if unknown:
+        raise ValueError(f"unknown lint pass(es): {unknown}; "
+                         f"choose from {sorted(PASSES)}")
+    findings: List[Finding] = []
+    for name in selected:
+        findings.extend(PASSES[name](root))
+    return sorted(findings, key=lambda f: (f.path, f.line, f.pass_id))

@@ -15,6 +15,8 @@ import threading
 from dataclasses import dataclass
 from typing import Iterable, Optional
 
+from ..analysis.lockorder import new_lock
+
 #: the named points in the stack that consult the framework
 SITES = frozenset({
     "service.send",          # client → server wire op (framed bytes)
@@ -120,7 +122,7 @@ class FaultPlan:
         )
         self.seed = int(seed)
         self._rng = random.Random(self.seed)
-        self._lock = threading.Lock()
+        self._lock = new_lock("faults.plan")
         self._hits: dict[str, int] = {}
         self._fired_by_rule: dict[int, int] = {}
         self._fired_by_site: dict[str, int] = {}

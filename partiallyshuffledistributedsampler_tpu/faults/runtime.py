@@ -15,6 +15,7 @@ import time
 from typing import Optional
 
 from .plan import FaultPlan, FaultRule
+from ..analysis.lockorder import new_lock
 
 
 class InjectedFault(RuntimeError):
@@ -33,7 +34,7 @@ class InjectedThreadDeath(BaseException):
     which is exactly the failure watchdogs exist to catch."""
 
 
-_lock = threading.Lock()
+_lock = new_lock("faults.runtime")
 _stack: list[FaultPlan] = []
 _env_checked = False
 

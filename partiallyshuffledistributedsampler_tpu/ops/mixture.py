@@ -732,8 +732,8 @@ def mixture_stream_at_generic(
             concrete = np.asarray(positions)
             if concrete.dtype == object:
                 concrete = None
-        except Exception:
-            concrete = None  # traced positions
+        except Exception:  # lint: allow-broad-except(traced positions stay symbolic)
+            concrete = None
     if big_positions is None:
         if concrete is None:
             raise TypeError(

@@ -35,7 +35,7 @@ def _unload() -> None:
 
         try:
             _ctypes.dlclose(_lib._handle)
-        except Exception:
+        except Exception:  # lint: allow-broad-except(best-effort dlclose on unload)
             pass
         _lib = None
 
@@ -109,7 +109,7 @@ def available() -> bool:
     try:
         _load()
         return True
-    except Exception:  # missing, corrupt, or wrong-arch .so: fall back
+    except Exception:  # lint: allow-broad-except(missing/corrupt .so falls back to numpy)
         return False
 
 

@@ -304,7 +304,10 @@ class PartialShuffleMixtureSampler(ChunkedIterMixin, _TorchSampler):
         layers = _elastic_layers_from_state(state.get("elastic")) or []
         layers = layers + [(int(state["num_replicas"]), int(state["offset"]))]
         sampler._elastic = sampler._compute_elastic(layers)
-        sampler._pending = None
+        from .torch_shim import _AsyncRegen
+        stale, sampler._pending = sampler._pending, None
+        if isinstance(stale, _AsyncRegen):
+            stale.discard()  # never abandon a live prefetch thread
         sampler._pending_epoch = None
         return sampler
 
@@ -439,7 +442,10 @@ class PartialShuffleMixtureSampler(ChunkedIterMixin, _TorchSampler):
         self.seed = int(state["seed"])
         self.epoch = int(state["epoch"])
         self._elastic = elastic
-        self._pending = None
+        from .torch_shim import _AsyncRegen
+        stale, self._pending = self._pending, None
+        if isinstance(stale, _AsyncRegen):
+            stale.discard()  # never abandon a live prefetch thread
         self._pending_epoch = None
         self._offset = offset
         self._consumed = offset

@@ -400,7 +400,9 @@ class PartiallyShuffleDistributedSampler(ChunkedIterMixin, _TorchSampler):
 
     def _install_elastic(self, layers) -> None:
         self._elastic = self._compute_elastic(layers)
-        self._pending = None
+        stale, self._pending = self._pending, None
+        if isinstance(stale, _AsyncRegen):
+            stale.discard()  # never abandon a live prefetch thread
         self._pending_epoch = None
 
     def _elastic_indices(self, epoch: int) -> np.ndarray:
@@ -564,7 +566,9 @@ class PartiallyShuffleDistributedSampler(ChunkedIterMixin, _TorchSampler):
         # the prefetch buffer was dispatched under the PREVIOUS (seed, epoch)
         # — serving it after a load would be the silent reshuffle this
         # method's validation exists to prevent
-        self._pending = None
+        stale, self._pending = self._pending, None
+        if isinstance(stale, _AsyncRegen):
+            stale.discard()  # never abandon a live prefetch thread
         self._pending_epoch = None
         self._offset = offset
         self._consumed = offset

@@ -51,6 +51,7 @@ from __future__ import annotations
 import threading
 
 from ..utils.metrics import MetricsRegistry
+from ..analysis.lockorder import new_lock
 
 #: counter names with a per-client breakdown
 _PER_CLIENT = (
@@ -67,12 +68,12 @@ class ServiceMetrics:
 
     def __init__(self, registry: MetricsRegistry | None = None) -> None:
         self.registry = registry if registry is not None else MetricsRegistry()
-        self._lock = threading.Lock()
-        self.clients: dict[int, dict[str, int]] = {}
-        self.departed: dict[str, int] = {}
+        self._lock = new_lock("service.metrics")
+        self.clients: dict[int, dict[str, int]] = {}  # guarded by: self._lock
+        self.departed: dict[str, int] = {}  # guarded by: self._lock
         self.tenant: str | None = None
         self._parent: ServiceMetrics | None = None
-        self._tenants: dict[str, ServiceMetrics] = {}
+        self._tenants: dict[str, ServiceMetrics] = {}  # guarded by: self._lock
 
     def scoped(self, tenant: str) -> "ServiceMetrics":
         """Per-tenant child view: private registry, private ``clients``

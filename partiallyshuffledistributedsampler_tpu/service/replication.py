@@ -46,6 +46,7 @@ from typing import Callable, Optional
 from .. import faults as F
 from .. import telemetry
 from . import protocol as P
+from ..analysis.lockorder import new_lock
 
 #: how many WAL records the in-memory log retains; a standby that falls
 #: further behind is re-bootstrapped via REPL_SYNC instead of replaying
@@ -65,12 +66,12 @@ class ReplicationLog:
     full state) rather than surfacing into the serving path."""
 
     def __init__(self, metrics=None, tail: int = LOG_TAIL) -> None:
-        self._lock = threading.Lock()
+        self._lock = new_lock("repl.log")
         self._cond = threading.Condition(self._lock)
-        self._records: deque = deque(maxlen=max(1, int(tail)))
-        self.lsn = 0               # last appended
-        self.resync_needed = False
-        self._urgent = False       # a non-absorbing record is pending
+        self._records: deque = deque(maxlen=max(1, int(tail)))  # guarded by: self._lock
+        self.lsn = 0               # guarded by: self._lock — last appended
+        self.resync_needed = False  # guarded by: self._lock
+        self._urgent = False       # guarded by: self._lock — non-absorbing record pending
         self._metrics = metrics
 
     def append(self, op: str, data: dict) -> None:

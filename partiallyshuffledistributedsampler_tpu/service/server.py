@@ -100,6 +100,7 @@ from typing import Optional
 import numpy as np
 
 from .. import faults as F
+from ..analysis.lockorder import new_lock
 from .. import telemetry
 from ..telemetry import annotate as _annotate, span as _span
 from ..tenancy import FairShareScheduler, TenantQuota, tenant_id_for
@@ -199,31 +200,31 @@ class IndexServer:
         )
         self.metrics = metrics if metrics is not None else ServiceMetrics()
         self.epoch = 0
-        self._lock = threading.Lock()          # leases / cursors / epoch
-        self._gen_lock = threading.Lock()      # the (epoch, rank) cache
-        self._cache: OrderedDict[tuple, object] = OrderedDict()
+        self._lock = new_lock("server.state")  # leases / cursors / epoch
+        self._gen_lock = new_lock("server.gencache")  # the (epoch, rank) cache
+        self._cache: OrderedDict[tuple, object] = OrderedDict()  # guarded by: self._gen_lock
         #: rank -> {"owner": conn_id|None, "last_seen": t, "batch": int}
-        self._leases: dict[int, dict] = {}
+        self._leases: dict[int, dict] = {}  # guarded by: self._lock
         #: rank -> {"epoch": e, "acked": int, "hi": int, "samples": int}
         #: (hi = highest seq ever served, a request at or below it is a
         #: resend; samples = served sample high-water, the consumption
         #: watermark an elastic barrier cuts on)
-        self._cursors: dict[int, dict] = {}
+        self._cursors: dict[int, dict] = {}  # guarded by: self._lock
         # ---- elastic membership state (all under self._lock) ----
         #: bumped at every reshard commit; GET_BATCH stamps it
-        self.generation = 0
+        self.generation = 0  # guarded by: self._lock
         #: SPEC.md §6 cascade [(world, consumed_units), ...] outermost
         #: first, applying to epoch ``elastic_epoch`` only
-        self.layers: list[tuple[int, int]] = []
-        self.elastic_epoch: Optional[int] = None
+        self.layers: list[tuple[int, int]] = []  # guarded by: self._lock
+        self.elastic_epoch: Optional[int] = None  # guarded by: self._lock
         #: un-drained allocations of dead ranks, served as a prefix of
         #: rank 0's stream: JSON-safe {epoch, rank, world, layers, lo, hi}
         #: descriptors over the PURE partition stream of their generation
-        self._orphans: list[dict] = []
+        self._orphans: list[dict] = []  # guarded by: self._lock
         #: in-flight reshard (phase 'freeze' → 'drain'), None otherwise
-        self._reshard: Optional[dict] = None
+        self._reshard: Optional[dict] = None  # guarded by: self._lock
         #: rank -> clock time its lease went vacant (membership_timeout)
-        self._vacated: dict[int, float] = {}
+        self._vacated: dict[int, float] = {}  # guarded by: self._lock
         self._listener: Optional[socket.socket] = None
         self._threads: list[threading.Thread] = []
         self._conn_socks: dict[int, socket.socket] = {}
@@ -746,7 +747,7 @@ class IndexServer:
                 F.fire("repl.promote")
             except F.InjectedThreadDeath:
                 raise
-            except Exception:
+            except Exception:  # lint: allow-broad-except(injected promote fault; client retries)
                 # the fault fires BEFORE any state flips: still a
                 # standby, and the failing-over client simply retries
                 return False
@@ -824,8 +825,8 @@ class IndexServer:
             F.fire("server.zombie_write")
         except F.InjectedThreadDeath:
             raise
-        except Exception:
-            pass  # an injected fault must not un-refuse the write
+        except Exception:  # lint: allow-broad-except(injected fault must not un-refuse)
+            pass
         self.metrics.inc("fenced_writes")
         return refusal
 
@@ -1158,8 +1159,8 @@ class IndexServer:
                     committed = self._commit_reshard_locked()
                 except F.InjectedThreadDeath:
                     raise
-                except Exception:
-                    pass  # injected commit fault: state intact, retried
+                except Exception:  # lint: allow-broad-except(injected commit fault; retried)
+                    pass
                 if not committed and len(rs["dead"]) > dead0:
                     self._repl_append("state",
                                       state=self._state_dict_locked())
@@ -1181,9 +1182,7 @@ class IndexServer:
                 self._trigger_reshard(trigger[0], dead=trigger[1])
             except F.InjectedThreadDeath:
                 raise
-            except Exception:
-                # injected trigger fault: membership unchanged; the sweep
-                # re-arms on its next tick
+            except Exception:  # lint: allow-broad-except(injected trigger fault; sweep re-arms)
                 pass
 
     # ------------------------------------------------------- per-connection
@@ -1378,8 +1377,8 @@ class IndexServer:
                                 committed = self._commit_reshard_locked()
                             except F.InjectedThreadDeath:
                                 raise
-                            except Exception:
-                                pass  # commit fault: drain intact, retried
+                            except Exception:  # lint: allow-broad-except(injected commit fault; retried)
+                                pass
                             if not committed:
                                 self._repl_append(
                                     "state",
@@ -1520,8 +1519,8 @@ class IndexServer:
                 self._commit_reshard_locked()
             except F.InjectedThreadDeath:
                 raise
-            except Exception:
-                pass  # injected commit fault: drain state intact, retried
+            except Exception:  # lint: allow-broad-except(injected commit fault; retried)
+                pass
         self._write_snapshot(force=True)
         return True
 
@@ -1897,7 +1896,7 @@ class IndexServer:
                     f"rank {want} no longer exists at world "
                     f"{self.spec.world}; rejoin with rank=-1"))
                 return
-            rank = self._claim_rank(want, conn_id, now)
+            rank = self._claim_rank_locked(want, conn_id, now)
             if rank is None:
                 code = "rank_taken" if 0 <= want < self.spec.world \
                     else "no_rank"
@@ -1928,7 +1927,7 @@ class IndexServer:
         self._write_snapshot()
         P.send_msg(sock, P.MSG_WELCOME, welcome)
 
-    def _claim_rank(self, want: int, conn_id: int, now: float):
+    def _claim_rank_locked(self, want: int, conn_id: int, now: float):
         """Grant ``want`` (or the lowest free rank for -1).  Called under
         ``self._lock``.  A stale live lease is evicted on the spot."""
         candidates = ([want] if want >= 0 else range(self.spec.world))
@@ -2047,8 +2046,8 @@ class IndexServer:
                             committed = self._commit_reshard_locked()
                         except F.InjectedThreadDeath:
                             raise
-                        except Exception:
-                            pass  # commit fault: drain intact, retried
+                        except Exception:  # lint: allow-broad-except(injected commit fault; retried)
+                            pass
                         if not committed:
                             self._repl_append(
                                 "state",

@@ -76,7 +76,7 @@ def reset() -> None:
     if sink is not None:
         try:
             sink.close()
-        except Exception:
+        except Exception:  # lint: allow-broad-except(best-effort sink close in reset)
             pass
     _RECORDER.sink = None
     _RECORDER.dump_dir = None

@@ -22,6 +22,8 @@ import threading
 import time
 from typing import Optional
 
+from ..analysis.lockorder import new_lock
+
 
 def _prom_name(prefix: str, name: str) -> str:
     out = []
@@ -91,7 +93,7 @@ class JsonlSink:
         self.path = str(path)
         self.interval_s = float(interval_s)
         self.batch = max(1, int(batch))
-        self._lock = threading.Lock()
+        self._lock = new_lock("telemetry.sink")
         self._buf: list[str] = []
         self._last_flush = time.monotonic()
         self._f = open(self.path, "a", encoding="utf-8")

@@ -22,6 +22,7 @@ import threading
 import time
 from collections import deque
 from typing import Optional
+from ..analysis.lockorder import new_lock
 
 
 class FlightRecorder:
@@ -31,17 +32,21 @@ class FlightRecorder:
     is where automatic dumps are written (``None`` disables them);
     ``max_dumps`` caps files written per recorder lifetime; ``sink`` is
     an optional live exporter (e.g. :class:`~.export.JsonlSink`) that
-    receives every entry as it is recorded."""
+    receives every entry as it is recorded; ``clock`` is the wall-clock
+    source stamped on dump metadata and filenames (injectable so tests
+    can pin dump timestamps)."""
 
     def __init__(self, capacity: int = 1024, dump_dir: Optional[str] = None,
-                 max_dumps: int = 16, sink=None) -> None:
-        self._lock = threading.Lock()
+                 max_dumps: int = 16, sink=None,
+                 clock=time.time) -> None:
+        self._lock = new_lock("telemetry.recorder")
         self._ring: deque = deque(maxlen=max(1, int(capacity)))
         self.dump_dir = dump_dir
         self.max_dumps = int(max_dumps)
         self.sink = sink
-        self._dump_seq = 0
-        self.dropped = 0  # entries pushed out of the ring
+        self.clock = clock
+        self._dump_seq = 0  # guarded by: self._lock
+        self.dropped = 0  # guarded by: self._lock — entries pushed out
 
     # ------------------------------------------------------------ recording
     def record(self, entry: dict) -> None:
@@ -53,8 +58,8 @@ class FlightRecorder:
         if sink is not None:
             try:
                 sink.write(entry)
-            except Exception:
-                pass  # a broken exporter must never take down the data path
+            except Exception:  # lint: allow-broad-except(a broken exporter must never take down the data path)
+                pass
 
     def snapshot(self, limit: Optional[int] = None) -> list[dict]:
         """Most-recent-last copy of the ring (optionally the last
@@ -84,12 +89,13 @@ class FlightRecorder:
         with self._lock:
             self._dump_seq += 1
             seq = self._dump_seq
+            dropped = self.dropped
         tmp = f"{path}.tmp.{os.getpid()}"
         with open(tmp, "w", encoding="utf-8") as f:
             f.write(json.dumps({
                 "kind": "flight_dump", "reason": str(reason), "seq": seq,
-                "wall": round(time.time(), 3), "entries": len(entries),
-                "open_spans": len(extra_entries), "dropped": self.dropped,
+                "wall": round(self.clock(), 3), "entries": len(entries),
+                "open_spans": len(extra_entries), "dropped": dropped,
             }, separators=(",", ":")) + "\n")
             for e in entries:
                 f.write(json.dumps(e, separators=(",", ":"),
@@ -111,7 +117,7 @@ class FlightRecorder:
                 return None
         os.makedirs(d, exist_ok=True)
         slug = re.sub(r"[^A-Za-z0-9._-]+", "_", str(reason))[:64] or "dump"
-        name = f"flight-{int(time.time() * 1e3):013d}-{slug}.jsonl"
+        name = f"flight-{int(self.clock() * 1e3):013d}-{slug}.jsonl"
         try:
             return self.dump(os.path.join(d, name), reason=reason,
                              extra_entries=extra_entries)

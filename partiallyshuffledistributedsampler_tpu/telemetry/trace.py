@@ -27,6 +27,7 @@ import random
 import threading
 import time
 from typing import Optional
+from ..analysis.lockorder import new_lock
 
 #: attrs/event payloads are redacted to small JSON-safe values at record
 #: time — a span can never smuggle index payloads into a dump
@@ -156,8 +157,8 @@ class Span:
             if not hasattr(exc, "_psds_span"):
                 try:
                     exc._psds_span = self.ids
-                except Exception:
-                    pass  # exceptions with __slots__ can't be tagged
+                except Exception:  # lint: allow-broad-except(exceptions with __slots__ can't be tagged)
+                    pass
         self.tracer._pop(self)
         return False
 
@@ -178,7 +179,7 @@ class Tracer:
         self.recorder = recorder
         self._clock = clock
         self._tls = threading.local()
-        self._lock = threading.Lock()
+        self._lock = new_lock("tracer")
         self._active: dict[str, Span] = {}
 
     # ------------------------------------------------------------- context
@@ -268,6 +269,6 @@ class Tracer:
         for s in spans:
             try:
                 out.append(s.entry(open=True))
-            except Exception:
-                continue  # racing mutation on another thread: skip it
+            except Exception:  # lint: allow-broad-except(racing mutation on another thread)
+                continue
         return out

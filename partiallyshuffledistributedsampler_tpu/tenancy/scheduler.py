@@ -26,6 +26,8 @@ import threading
 from contextlib import contextmanager
 from typing import Dict, List, Optional
 
+from ..analysis.lockorder import new_lock
+
 __all__ = ["FairShareScheduler"]
 
 
@@ -35,17 +37,17 @@ class FairShareScheduler:
         self.concurrency = max(1, int(concurrency))
         self.default_weight = float(default_weight)
         self._metrics = metrics  # MetricsRegistry or None
-        self._lock = threading.Lock()
+        self._lock = new_lock("tenancy.scheduler")
         self._cond = threading.Condition(self._lock)
-        self._waiters: List[tuple] = []  # heap of (tag, seq, entry)
-        self._seq = 0
-        self._running = 0
-        self._running_by_tenant: Dict[str, int] = {}
-        self._vt: Dict[str, float] = {}  # tenant -> next start tag
-        self._clock = 0.0  # start tag of the most recently dispatched job
-        self._weights: Dict[str, float] = {}
-        self._caps: Dict[str, int] = {}
-        self.dispatched = 0
+        self._waiters: List[tuple] = []  # guarded by: self._lock — heap of (tag, seq, entry)
+        self._seq = 0  # guarded by: self._lock
+        self._running = 0  # guarded by: self._lock
+        self._running_by_tenant: Dict[str, int] = {}  # guarded by: self._lock
+        self._vt: Dict[str, float] = {}  # guarded by: self._lock — tenant -> next start tag
+        self._clock = 0.0  # guarded by: self._lock — last dispatched start tag
+        self._weights: Dict[str, float] = {}  # guarded by: self._lock
+        self._caps: Dict[str, int] = {}  # guarded by: self._lock
+        self.dispatched = 0  # guarded by: self._lock
 
     def set_quota(self, tenant: str, weight: Optional[float] = None,
                   concurrency: Optional[int] = None) -> None:

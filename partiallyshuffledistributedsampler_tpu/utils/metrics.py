@@ -16,6 +16,8 @@ import time
 from collections import deque
 from contextlib import contextmanager
 
+from ..analysis.lockorder import new_lock
+
 
 class _SampleRing:
     """Fixed-size tail of samples with exact running totals.
@@ -129,15 +131,15 @@ class Histogram:
                  "_min", "_max")
 
     def __init__(self, bounds=None) -> None:
-        self._lock = threading.Lock()
+        self._lock = new_lock("metrics.histogram")
         self.bounds = tuple(float(b) for b in (bounds or _DEFAULT_BOUNDS))
         if list(self.bounds) != sorted(self.bounds):
             raise ValueError("histogram bounds must be sorted ascending")
-        self._counts = [0] * (len(self.bounds) + 1)  # +1 = overflow
-        self._sum = 0.0
-        self._count = 0
-        self._min = math.inf
-        self._max = -math.inf
+        self._counts = [0] * (len(self.bounds) + 1)  # guarded by: self._lock
+        self._sum = 0.0  # guarded by: self._lock
+        self._count = 0  # guarded by: self._lock
+        self._min = math.inf  # guarded by: self._lock
+        self._max = -math.inf  # guarded by: self._lock
 
     def observe(self, value_ms: float) -> None:
         v = float(value_ms)
@@ -153,7 +155,8 @@ class Histogram:
 
     @property
     def count(self) -> int:
-        return self._count
+        with self._lock:
+            return self._count
 
     def percentile(self, q: float) -> float:
         """Interpolated q-quantile (q in [0, 1]) from the bucket counts."""
@@ -223,10 +226,10 @@ class MetricsRegistry:
     thread per connection)."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
-        self._counters: dict[str, int] = {}
-        self._timers: dict[str, RegenTimer] = {}
-        self._histograms: dict[str, Histogram] = {}
+        self._lock = new_lock("metrics.registry")
+        self._counters: dict[str, int] = {}  # guarded by: self._lock
+        self._timers: dict[str, RegenTimer] = {}  # guarded by: self._lock
+        self._histograms: dict[str, Histogram] = {}  # guarded by: self._lock
 
     def inc(self, name: str, value: int = 1) -> int:
         with self._lock:

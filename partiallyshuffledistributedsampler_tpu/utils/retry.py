@@ -30,6 +30,7 @@ import time
 from typing import Optional
 
 from ..telemetry import current as _current_span
+from ..analysis.lockorder import new_lock
 
 
 class RetryPolicy:
@@ -87,7 +88,7 @@ class RetryPolicy:
         self._clock = clock
         self._sleep = sleep
         self._rng = rng if rng is not None else random.Random()
-        self._lock = threading.Lock()
+        self._lock = new_lock("utils.retry")
         self._consecutive_failures = 0
         self._opened_at: Optional[float] = None
 

@@ -56,5 +56,5 @@ class StallError(RuntimeError):
             from ..telemetry import auto_dump
             auto_dump("stall", thread=self.thread_name,
                       alive=self.thread_alive)
-        except Exception:
+        except Exception:  # lint: allow-broad-except(observability is best-effort here)
             pass

@@ -30,6 +30,51 @@ except ImportError:
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+import pytest  # noqa: E402
+
+from partiallyshuffledistributedsampler_tpu.analysis import lockorder  # noqa: E402
+
+#: tests in these groups drive the threaded service stack and must not
+#: leave non-daemon threads behind (docs/ANALYSIS.md "Thread-leak gate")
+_LEAK_CHECKED_MARKS = ("failover", "tenancy", "chaos", "elastic", "telemetry")
+
+
+@pytest.fixture(autouse=True)
+def _concurrency_sanitizer(request):
+    """Per-test concurrency gates.
+
+    * Thread leaks: for service/failover/tenancy-style tests (any
+      ``_LEAK_CHECKED_MARKS`` marker, or a ``test_service*`` module), any
+      non-daemon thread alive after teardown that was not alive before
+      the test fails it, with the leaked thread's current stack.
+    * Lock order: under ``PSDS_SANITIZE=1`` every test additionally
+      fails if it recorded a new lock-order cycle (potential deadlock),
+      with both acquisition stacks rendered.
+    """
+    leak_checked = (
+        any(request.node.get_closest_marker(m) is not None
+            for m in _LEAK_CHECKED_MARKS)
+        or "test_service" in request.node.nodeid
+    )
+    baseline = lockorder.thread_snapshot() if leak_checked else None
+    violations_before = (len(lockorder.violations())
+                        if lockorder.is_enabled() else 0)
+    yield
+    if lockorder.is_enabled():
+        new = lockorder.violations()[violations_before:]
+        if new:
+            pytest.fail(
+                "lock-order cycle(s) recorded during this test:\n"
+                + lockorder.render_violations(new), pytrace=False)
+    if baseline is not None:
+        leaked = lockorder.leaked_threads(baseline)
+        if leaked:
+            stacks = lockorder.thread_stacks(leaked)
+            pytest.fail(
+                "non-daemon thread(s) leaked by this test:\n" + "\n".join(
+                    f"--- {name} ---\n{stack}"
+                    for name, stack in stacks.items()), pytrace=False)
+
 
 def assert_exactly_once(consumed_vals, remainder_vals, stream, old_world,
                         consumed, partition, new_world):

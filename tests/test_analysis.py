@@ -1,0 +1,475 @@
+"""Self-tests for the analysis subsystem (docs/ANALYSIS.md).
+
+Three layers:
+
+* the repo gate — every lint pass over the package itself must report
+  zero findings (the same bar ``make analyze`` enforces in CI);
+* golden-snippet tests per static pass, including the waiver syntax and
+  its no-empty-reason rule;
+* the runtime sanitizer — a deliberate AB/BA lock inversion must produce
+  a cycle report naming both acquisition stacks, ``TrackedLock`` must
+  stay exact through ``threading.Condition``, ``new_lock`` must be raw
+  (zero-cost) when the sanitizer is off, and the thread-leak detector
+  must both catch a lingering non-daemon thread and go quiet once it
+  exits.  Plus the ride-along regression: abandoning a pending
+  ``_AsyncRegen`` must join its worker thread.
+"""
+
+import textwrap
+import threading
+import time
+
+import pytest
+
+from partiallyshuffledistributedsampler_tpu.analysis import lint, lockorder
+from partiallyshuffledistributedsampler_tpu.analysis.lint import (
+    PASSES,
+    check_clocks,
+    check_guarded_by,
+    check_silent_except,
+    default_root,
+    doc_metric_tokens,
+    lint_fault_sites,
+    lint_metrics_docs,
+    lint_protocol,
+    run_all,
+)
+
+
+def _src(text: str) -> str:
+    return textwrap.dedent(text)
+
+
+# --------------------------------------------------------------- repo gate
+@pytest.mark.parametrize("name", sorted(PASSES))
+def test_repo_has_zero_findings(name):
+    findings = PASSES[name](default_root())
+    assert not findings, "\n".join(f.render() for f in findings)
+
+
+def test_run_all_rejects_unknown_pass():
+    with pytest.raises(ValueError):
+        run_all(default_root(), ["no-such-pass"])
+
+
+# ----------------------------------------------------- guarded-by golden
+def test_guarded_by_flags_unlocked_access():
+    findings = check_guarded_by(_src("""
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.x = 0  # guarded by: self._lock
+
+            def bad(self):
+                return self.x
+    """), "snippet.py")
+    assert len(findings) == 1
+    assert "C.bad" in findings[0].message and "self.x" in findings[0].message
+
+
+def test_guarded_by_accepts_with_lock_and_locked_suffix():
+    findings = check_guarded_by(_src("""
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.x = 0  # guarded by: self._lock
+
+            def good(self):
+                with self._lock:
+                    return self.x
+
+            def _read_locked(self):
+                return self.x
+    """), "snippet.py")
+    assert findings == []
+
+
+def test_guarded_by_condition_aliases_its_lock():
+    findings = check_guarded_by(_src("""
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cond = threading.Condition(self._lock)
+                self.x = 0  # guarded by: self._lock
+
+            def good(self):
+                with self._cond:
+                    self.x += 1
+    """), "snippet.py")
+    assert findings == []
+
+
+def test_guarded_by_waiver_needs_a_reason():
+    base = """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.x = 0  # guarded by: self._lock
+
+            def racy(self):
+                return self.x  # lint: allow-unguarded(%s)
+    """
+    assert check_guarded_by(_src(base % "monotonic flag, stale read ok"),
+                            "snippet.py") == []
+    findings = check_guarded_by(_src(base % ""), "snippet.py")
+    assert len(findings) == 1
+    assert "needs a reason" in findings[0].message
+
+
+# --------------------------------------------------------- clocks golden
+def test_clocks_only_applies_to_injectable_modules():
+    wallclock = """
+        import time
+
+        def stamp(%s):
+            return time.time()
+    """
+    # no clock= parameter anywhere: wall clock is fine
+    assert check_clocks(_src(wallclock % ""), "snippet.py") == []
+    # an injectable module must route through the injected clock
+    findings = check_clocks(_src(wallclock % "clock=time.time"),
+                            "snippet.py")
+    assert len(findings) == 1
+    assert "injectable clock=" in findings[0].message
+
+
+def test_clocks_flags_datetime_now_and_accepts_waiver():
+    findings = check_clocks(_src("""
+        import datetime
+
+        def stamp(clock=None):
+            return datetime.datetime.now()
+    """), "snippet.py")
+    assert len(findings) == 1
+    waived = check_clocks(_src("""
+        import time
+
+        def stamp(clock=None):
+            return time.time()  # lint: allow-wallclock(log line only)
+    """), "snippet.py")
+    assert waived == []
+
+
+# ------------------------------------------------- silent-except golden
+def test_silent_except_flags_bare_pass():
+    findings = check_silent_except(_src("""
+        def f():
+            try:
+                work()
+            except Exception:
+                pass
+    """), "snippet.py")
+    assert len(findings) == 1
+
+
+@pytest.mark.parametrize("body", [
+    "raise",                       # re-raise
+    "metrics.inc('errors')",       # counter bump
+    "log(exc)",                    # the exception is used
+])
+def test_silent_except_accepts_handled_errors(body):
+    findings = check_silent_except(_src(f"""
+        def f():
+            try:
+                work()
+            except Exception as exc:
+                {body}
+    """), "snippet.py")
+    assert findings == []
+
+
+def test_silent_except_import_guard_exempt_but_not_assign_only():
+    guard = check_silent_except(_src("""
+        try:
+            import torch
+            HAVE_TORCH = True
+        except Exception:
+            HAVE_TORCH = False
+    """), "snippet.py")
+    assert guard == []
+    # a try body with no import is NOT an import guard
+    findings = check_silent_except(_src("""
+        def f(exc, ids):
+            try:
+                exc.tag = ids
+            except Exception:
+                pass
+    """), "snippet.py")
+    assert len(findings) == 1
+
+
+def test_silent_except_waiver_and_empty_reason():
+    assert check_silent_except(_src("""
+        def f():
+            try:
+                work()
+            except Exception:  # lint: allow-broad-except(best effort)
+                pass
+    """), "snippet.py") == []
+    findings = check_silent_except(_src("""
+        def f():
+            try:
+                work()
+            except Exception:  # lint: allow-broad-except()
+                pass
+    """), "snippet.py")
+    assert len(findings) == 1
+    assert "needs a reason" in findings[0].message
+
+
+# --------------------------------------------- fault-sites golden (tmp repo)
+def _mini_repo(tmp_path, files):
+    for rel, text in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(text), encoding="utf-8")
+    return tmp_path
+
+
+def test_fault_sites_drift_both_directions(tmp_path):
+    pkg = lint._PKG
+    root = _mini_repo(tmp_path, {
+        f"{pkg}/faults/plan.py": """
+            SITES = frozenset({"net.send", "never.drawn"})
+        """,
+        f"{pkg}/mod.py": """
+            def f(F):
+                F.draw("net.send")
+                F.fire("not.registered")
+        """,
+    })
+    findings = lint_fault_sites(root)
+    msgs = [f.message for f in findings]
+    assert len(findings) == 2
+    assert any("'not.registered'" in m and "absent from" in m for m in msgs)
+    assert any("'never.drawn'" in m and "no code draws" in m for m in msgs)
+
+
+# ------------------------------------------------ protocol golden (tmp repo)
+def test_protocol_dead_opcode_and_unhandled_error_code(tmp_path):
+    pkg = lint._PKG
+    root = _mini_repo(tmp_path, {
+        f"{pkg}/service/protocol.py": """
+            MSG_PING = 1
+            MSG_PONG = 2
+            MSG_DEAD = 3
+        """,
+        f"{pkg}/service/server.py": """
+            from . import protocol as P
+
+            def serve(sock, msg):
+                if msg == P.MSG_PING:
+                    P.send_msg(sock, P.MSG_PONG, {"code": "weird_code"})
+        """,
+        f"{pkg}/service/client.py": """
+            from . import protocol as P
+
+            HANDLED = ("ok_code",)
+            _PING, _PONG = P.MSG_PING, P.MSG_PONG
+        """,
+        f"{pkg}/service/replication.py": """
+            # no error-code handling here
+        """,
+    })
+    findings = lint_protocol(root)
+    msgs = [f.message for f in findings]
+    assert any("MSG_DEAD" in m and "dead opcode" in m for m in msgs)
+    assert any("MSG_DEAD" in m and "no server dispatch arm" in m
+               for m in msgs)
+    assert any("'weird_code'" in m for m in msgs)
+    assert not any("MSG_PING" in m or "MSG_PONG" in m for m in msgs)
+
+
+# -------------------------------------------- metrics-docs golden (tmp repo)
+def test_doc_metric_tokens_need_metric_context():
+    text = _src("""
+        The `epoch_regen_ms` timer tracks regen latency.
+
+        This paragraph mentions `some_kwarg` but no metric words.
+    """)
+    tokens = doc_metric_tokens(text)
+    assert "epoch_regen_ms" in tokens
+    assert "some_kwarg" not in tokens
+
+
+def test_metrics_docs_drift(tmp_path):
+    pkg = lint._PKG
+    root = _mini_repo(tmp_path, {
+        f"{pkg}/mod.py": """
+            def f(registry):
+                registry.inc("hits_total")
+        """,
+        "docs/GOOD.md": """
+            The `hits_total` counter counts hits.
+        """,
+        "docs/BAD.md": """
+            The `missing_total` counter does not exist in code.
+        """,
+    })
+    findings = lint_metrics_docs(root)
+    assert len(findings) == 1
+    assert findings[0].path == "docs/BAD.md"
+    assert "`missing_total`" in findings[0].message
+
+
+# ----------------------------------------------------- runtime sanitizer
+@pytest.fixture
+def sanitizer():
+    """Enable the sanitizer for one test, restoring the prior state (the
+    suite may already run under PSDS_SANITIZE=1) and clearing whatever
+    graph state the test recorded."""
+    prior = lockorder.is_enabled()
+    lockorder.enable()
+    yield lockorder
+    lockorder.reset()
+    if not prior:
+        lockorder.disable()
+
+
+def test_lock_inversion_reports_both_stacks(sanitizer):
+    a = lockorder.TrackedLock("test.A")
+    b = lockorder.TrackedLock("test.B")
+
+    def order_ab():
+        with a:
+            with b:
+                pass
+
+    def order_ba():
+        with b:
+            with a:
+                pass
+
+    for fn in (order_ab, order_ba):  # sequential: no real deadlock risk
+        t = threading.Thread(target=fn)
+        t.start()
+        t.join()
+
+    reports = lockorder.violations()
+    assert len(reports) == 1
+    rep = reports[0]
+    assert set(rep["this_edge"]) == {"test.A", "test.B"}
+    assert set(rep["other_edge"]) == {"test.A", "test.B"}
+    assert rep["this_edge"] != rep["other_edge"]
+    # both acquisition stacks are captured and name their call sites
+    assert "order_ba" in rep["this_stack"]
+    assert "order_ab" in rep["other_stack"]
+    rendered = lockorder.render_violations(reports)
+    assert "test.A" in rendered and "test.B" in rendered
+    assert "order_ab" in rendered and "order_ba" in rendered
+
+
+def test_consistent_order_records_no_violation(sanitizer):
+    a = lockorder.TrackedLock("test.outer")
+    b = lockorder.TrackedLock("test.inner")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert lockorder.violations() == []
+    assert lockorder.stats()["edges"] >= 1
+
+
+def test_tracked_lock_works_under_condition(sanitizer):
+    lk = lockorder.TrackedLock("test.cond")
+    cond = threading.Condition(lk)
+    box = []
+
+    def waiter():
+        with cond:
+            while not box:
+                cond.wait(1.0)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.05)
+    with cond:
+        box.append(1)
+        cond.notify_all()
+    t.join(2.0)
+    assert not t.is_alive()
+    assert not lk.locked()
+    # the held-set bookkeeping survived wait()'s release/re-acquire
+    assert getattr(lockorder._STATE.tls, "held", []) == []
+    assert lockorder.violations() == []
+
+
+def test_new_lock_is_raw_when_disabled():
+    prior = lockorder.is_enabled()
+    lockorder.disable()
+    try:
+        raw = lockorder.new_lock("test.off")
+        assert type(raw) is type(threading.Lock())
+    finally:
+        if prior:
+            lockorder.enable()
+    if prior:
+        assert isinstance(lockorder.new_lock("test.on"),
+                          lockorder.TrackedLock)
+
+
+def test_thread_leak_detector_names_the_stuck_frame():
+    base = lockorder.thread_snapshot()
+    release = threading.Event()
+    t = threading.Thread(target=release.wait, name="deliberate-leak",
+                         daemon=False)
+    t.start()
+    try:
+        leaked = lockorder.leaked_threads(base, grace_s=0.2)
+        assert [x.name for x in leaked] == ["deliberate-leak"]
+        stacks = lockorder.thread_stacks(leaked)
+        assert "wait" in stacks["deliberate-leak"]
+    finally:
+        release.set()
+        t.join()
+    assert lockorder.leaked_threads(base, grace_s=1.0) == []
+
+
+# ------------------------------------------------- _AsyncRegen ride-along
+def test_load_state_dict_joins_pending_regen():
+    torch = pytest.importorskip("torch")  # noqa: F841
+    from partiallyshuffledistributedsampler_tpu import (
+        PartiallyShuffleDistributedSampler,
+    )
+    from partiallyshuffledistributedsampler_tpu.sampler.torch_shim import (
+        _AsyncRegen,
+    )
+
+    s = PartiallyShuffleDistributedSampler(
+        1000, num_replicas=2, rank=0, window=64, backend="cpu")
+    s.set_epoch(1)
+    pending = s._pending
+    assert isinstance(pending, _AsyncRegen)
+    s.load_state_dict(s.state_dict())
+    # the abandoned prefetch worker was joined, not leaked
+    assert not pending._t.is_alive()
+    assert s._pending is None
+    assert list(s)  # the sampler still serves the restored epoch
+
+
+def test_mixture_load_state_dict_joins_pending_regen():
+    torch = pytest.importorskip("torch")  # noqa: F841
+    from partiallyshuffledistributedsampler_tpu.sampler.mixture import (
+        PartialShuffleMixtureSampler,
+    )
+    from partiallyshuffledistributedsampler_tpu.sampler.torch_shim import (
+        _AsyncRegen,
+    )
+
+    s = PartialShuffleMixtureSampler(
+        [100, 200, 50], [5, 3, 2], num_replicas=2, rank=0, block=16,
+        backend="cpu")
+    s.set_epoch(1)
+    pending = s._pending
+    assert isinstance(pending, _AsyncRegen)
+    s.load_state_dict(s.state_dict())
+    assert not pending._t.is_alive()
+    assert s._pending is None

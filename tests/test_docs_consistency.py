@@ -139,6 +139,32 @@ def test_observability_doc_cross_linked():
     assert "TRACE_DUMP" in svc and "TRACE_REPORT" in svc
 
 
+def test_analysis_doc_cross_linked():
+    """docs/ANALYSIS.md exists, the docs whose invariants it enforces
+    point at it, and its load-bearing claims (waiver syntax, sanitizer
+    env var, the make gate) stay documented."""
+    analysis_md = DOCS / "ANALYSIS.md"
+    assert analysis_md.exists()
+    for doc in ("ARCHITECTURE.md", "RESILIENCE.md"):
+        assert "ANALYSIS.md" in (DOCS / doc).read_text(), (
+            f"docs/{doc} lost its cross-link to docs/ANALYSIS.md"
+        )
+    readme = (DOCS.parent / "README.md").read_text()
+    assert "docs/ANALYSIS.md" in readme
+    text = analysis_md.read_text()
+    for token in ("make analyze", "PSDS_SANITIZE=1", "allow-broad-except",
+                  "allow-unguarded", "allow-wallclock",
+                  "render_violations", "sanitize_overhead_within_noise"):
+        assert token in text, f"docs/ANALYSIS.md lost `{token}`"
+    # the documented pass names must be the registered ones
+    from partiallyshuffledistributedsampler_tpu.analysis import lint
+
+    for name in lint.PASSES:
+        assert f"`{name}`" in text, (
+            f"docs/ANALYSIS.md does not document the `{name}` pass"
+        )
+
+
 def test_tenancy_doc_cross_linked():
     """The multi-tenant surface is documented where an operator would
     look: SERVICE.md owns the namespace/quota/fair-share story (with
