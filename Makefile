@@ -42,6 +42,11 @@
 #                          namespaces: two-tenant bit-identity, fair-
 #                          share starvation bound, admission quotas,
 #                          then the co-residency-within-noise bar
+#   * durability smoke     tests/test_durability.py (`-m durability`)
+#                          + benchmarks/durability_smoke.py — disk-backed
+#                          WAL: kill-at-any-byte crash matrix, torn-tail
+#                          goldens, checkpoint fallback, then the WAL-
+#                          overhead + recovery-bounded-by-tail bars
 #   * analyze              project-native static analysis (docs/ANALYSIS.md):
 #                          guarded-by discipline, fault-site/protocol/
 #                          metrics-docs drift, clock discipline, silent-
@@ -55,7 +60,7 @@ PY ?= python
 
 .PHONY: check test bench native dryrun service-smoke chaos-smoke \
 	elastic-smoke telemetry-smoke failover-smoke tenancy-smoke \
-	analyze analysis-smoke
+	durability-smoke analyze analysis-smoke
 
 # the driver parses the LAST line of bench.py's combined output (round 3
 # lost its headline to the details line — BENCH_r03.json "parsed": null),
@@ -117,6 +122,13 @@ failover-smoke:
 tenancy-smoke:
 	$(PY) -m pytest tests/test_tenancy.py -q -m tenancy -ra
 	$(PY) benchmarks/tenancy_smoke.py
+
+# durability gate (docs/RESILIENCE.md "Durability & recovery"): the WAL
+# suite (torn-tail goldens, kill-at-any-byte crash matrix, checkpoint
+# fallback, fsync-policy equivalence), then the overhead + recovery smoke
+durability-smoke:
+	$(PY) -m pytest tests/test_durability.py -q -m durability -ra
+	$(PY) benchmarks/durability_smoke.py
 
 # static-analysis gate (docs/ANALYSIS.md): every lint pass over the
 # package + docs; any finding is a non-zero exit with file:line output

@@ -406,6 +406,21 @@ def main() -> None:
         except Exception as exc:
             details["failover_error"] = repr(exc)[:200]
 
+    # detail tier: durability — group-commit WAL overhead vs the
+    # WAL-off arm, checkpoint+tail replay vs a full from-lsn-0 rebuild,
+    # and one crash+recover drill (methodology in
+    # benchmarks/durability_smoke.py)
+    if not smoke:
+        try:
+            sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+            from benchmarks.durability_smoke import (
+                summarize as durability_summarize,
+            )
+
+            details["durability"] = durability_summarize()
+        except Exception as exc:
+            details["durability_error"] = repr(exc)[:200]
+
     # detail tier: tenancy — multi-tenant co-residency overhead vs a
     # dedicated daemon + the concurrent fair-share drill (methodology in
     # benchmarks/tenancy_smoke.py)

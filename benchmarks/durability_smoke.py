@@ -1,0 +1,239 @@
+"""Durability smoke: WAL overhead, bounded recovery, crash bit-identity.
+
+Two consumers:
+
+* ``make durability-smoke`` / ``python benchmarks/durability_smoke.py``
+  — the CI gate: (1) serving with a group-commit WAL must stay within
+  the WAL-off arm's own rep-to-rep noise; (2) recovery from an
+  incremental checkpoint + tail replay must replay a small fraction of
+  the log and take no longer than rebuilding from lsn 0 — recovery cost
+  tracks the tail, not history; (3) a daemon hard-killed mid-epoch and
+  recovered from its WAL must serve the remaining stream bit-identically
+  to the uncrashed reference.  Exit 0 and one JSON line on success;
+  raises loudly on any miss.
+
+* ``bench.py`` imports :func:`summarize` — the ``details["durability"]``
+  tier: the same three figures (append overhead per step, tail-vs-full
+  replay record counts and wall, crash-recovery wall).
+
+Both figures describe the durability layer (docs/RESILIENCE.md,
+"Durability & recovery"): everything runs on loopback against tmpfs-ish
+local disk, so the fsync figures are a floor, not a fleet promise.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+#: a quiet machine's rep spread can be ~0; the overhead bar still needs
+#: slack for scheduler jitter on loaded CI boxes
+_NOISE_FLOOR_MS_PER_STEP = 0.05
+
+
+def _epoch_wall_ms(client, epoch):
+    t0 = time.perf_counter()
+    got = client.epoch_indices(epoch)
+    return (time.perf_counter() - t0) * 1e3, got
+
+
+def _wal_overhead(*, n: int, window: int, batch: int, reps: int) -> dict:
+    """Served epoch wall per step, group-commit WAL vs no WAL at all.
+
+    The append is a lock-held frame+buffered-write; the fsync batches
+    under the group-commit policy — the WAL-on arm must land inside the
+    WAL-off arm's own max-min rep spread."""
+    from partiallyshuffledistributedsampler_tpu.service import (
+        IndexServer,
+        PartialShuffleSpec,
+        ServiceIndexClient,
+    )
+
+    spec = PartialShuffleSpec.plain(n, window=window, seed=0, world=1)
+    ref = np.asarray(spec.rank_indices(1, 0))
+    steps = -(-n // batch)
+    off_ms, on_ms = [], []
+
+    with IndexServer(spec) as srv:
+        with ServiceIndexClient(srv.address, rank=0, batch=batch) as c:
+            _epoch_wall_ms(c, 1)  # warm the epoch array cache
+            for _ in range(reps):
+                ms, got_off = _epoch_wall_ms(c, 1)
+                off_ms.append(ms)
+
+    with tempfile.TemporaryDirectory() as d:
+        spec2 = PartialShuffleSpec.plain(n, window=window, seed=0, world=1)
+        with IndexServer(spec2, wal_dir=os.path.join(d, "wal"),
+                         fsync="group_commit") as srv:
+            with ServiceIndexClient(srv.address, rank=0, batch=batch) as c:
+                _epoch_wall_ms(c, 1)
+                for _ in range(reps):
+                    ms, got_on = _epoch_wall_ms(c, 1)
+                    on_ms.append(ms)
+
+    if not (np.array_equal(got_off, ref) and np.array_equal(got_on, ref)):
+        raise AssertionError("served stream changed under the WAL — "
+                             "durability must never touch the data")
+    noise = max((max(off_ms) - min(off_ms)) / steps,
+                _NOISE_FLOOR_MS_PER_STEP)
+    delta = (float(np.median(on_ms)) - float(np.median(off_ms))) / steps
+    return {
+        "wal_off_ms_per_step": round(float(np.median(off_ms)) / steps, 5),
+        "wal_on_ms_per_step": round(float(np.median(on_ms)) / steps, 5),
+        "noise_ms_per_step": round(noise, 5),
+        "overhead_ms_per_step": round(delta, 5),
+        "within_noise": bool(delta <= noise),
+        "reps": reps, "steps": steps,
+    }
+
+
+def _recovery_drill(*, n: int, window: int, batch: int,
+                    epochs: int = 4) -> dict:
+    """Checkpoint + tail replay vs a full from-lsn-0 rebuild of the SAME
+    log: the incremental arm must replay a small fraction of the
+    records and take no longer — recovery cost tracks the tail."""
+    from partiallyshuffledistributedsampler_tpu.durability.recover import (
+        recover_unstarted,
+    )
+    from partiallyshuffledistributedsampler_tpu.service import (
+        IndexServer,
+        PartialShuffleSpec,
+        ServiceIndexClient,
+    )
+
+    def make_spec():
+        return PartialShuffleSpec.plain(n, window=window, seed=0, world=1)
+
+    with tempfile.TemporaryDirectory() as d:
+        snap = os.path.join(d, "snap.json")
+        wal_dir = os.path.join(d, "wal")
+        srv = IndexServer(make_spec(), snapshot_path=snap, wal_dir=wal_dir)
+        srv.start()
+        try:
+            with ServiceIndexClient(srv.address, rank=0, batch=batch) as c:
+                for e in range(epochs):
+                    c.epoch_indices(e)
+        finally:
+            srv.kill()  # no final seal: leave a real tail to replay
+
+        # arm A: full rebuild from lsn 0 (the snapshot withheld)
+        bare = os.path.join(d, "bare")
+        shutil.copytree(wal_dir, bare)
+        full_srv = IndexServer(make_spec(), wal_dir=bare)
+        full = recover_unstarted(full_srv)
+        full_srv._wal.close(sync=False)
+
+        # arm B: checkpoint restore + tail replay
+        tail_srv = IndexServer(make_spec(), snapshot_path=snap,
+                               wal_dir=wal_dir)
+        tail = recover_unstarted(tail_srv)
+        tail_srv._wal.close(sync=False)
+
+    if tail_srv._cursors != full_srv._cursors \
+            or tail_srv.epoch != full_srv.epoch:
+        raise AssertionError("tail replay and full rebuild disagree on "
+                             "the recovered state")
+    if not full["replayed"]:
+        raise AssertionError("the drill never recorded anything to replay")
+    return {
+        "full_replayed_records": int(full["replayed"]),
+        "tail_replayed_records": int(tail["replayed"]),
+        "full_replay_ms": round(float(full["replay_ms"]), 3),
+        "tail_replay_ms": round(float(tail["replay_ms"]), 3),
+        "tail_fraction": round(tail["replayed"] / max(full["replayed"], 1),
+                               4),
+        "bounded_by_tail": bool(
+            tail["replayed"] * 4 <= full["replayed"]
+            and tail["replay_ms"] <= full["replay_ms"] * 1.5),
+    }
+
+
+def _crash_drill(*, n: int, window: int, batch: int) -> dict:
+    """Hard-kill the daemon mid-epoch (no snapshot at all), restart it
+    on the same address from the WAL alone, and let the SAME client
+    iterator ride through: the delivered stream must be bit-identical
+    to the uncrashed reference."""
+    from partiallyshuffledistributedsampler_tpu.service import (
+        IndexServer,
+        PartialShuffleSpec,
+        ServiceIndexClient,
+    )
+
+    spec = PartialShuffleSpec.plain(n, window=window, seed=0, world=1)
+    ref = np.asarray(spec.rank_indices(2, 0))
+    with tempfile.TemporaryDirectory() as d:
+        wal_dir = os.path.join(d, "wal")
+        srv = IndexServer(spec, wal_dir=wal_dir)
+        host, port = srv.start()
+        client = ServiceIndexClient((host, port), rank=0, batch=batch,
+                                    backoff_base=0.02,
+                                    reconnect_timeout=10.0)
+        try:
+            client.set_epoch(2)
+            it = client.epoch_batches(2)
+            got = [next(it) for _ in range(3)]
+            srv.kill()
+            t0 = time.perf_counter()
+            spec2 = PartialShuffleSpec.plain(n, window=window, seed=0,
+                                             world=1)
+            srv2 = IndexServer(spec2, host=host, port=port, wal_dir=wal_dir)
+            srv2.start()
+            recover_ms = (time.perf_counter() - t0) * 1e3
+            try:
+                if srv2.epoch != 2:
+                    raise AssertionError(
+                        "the epoch lived only in the WAL and was lost")
+                got.append(next(it))
+                resume_ms = (time.perf_counter() - t0) * 1e3
+                got.extend(it)
+                counters = srv2.metrics.report()["counters"]
+            finally:
+                srv2.stop()
+        finally:
+            client.close()
+    if not np.array_equal(np.concatenate(got), ref):
+        raise AssertionError("stream diverged across the crash+recover")
+    if counters.get("wal_recoveries", 0) < 1:
+        raise AssertionError("the drill never actually recovered")
+    return {
+        "recover_ms": round(recover_ms, 3),
+        "client_resume_ms": round(resume_ms, 3),
+        "wal_recoveries": int(counters.get("wal_recoveries", 0)),
+    }
+
+
+def summarize(*, n: int = 50_000, window: int = 256, batch: int = 256,
+              reps: int = 5) -> dict:
+    """The bench.py ``details["durability"]`` tier: WAL overhead,
+    bounded recovery, and one crash drill."""
+    return {
+        "overhead": _wal_overhead(n=n, window=window, batch=batch,
+                                  reps=reps),
+        "recovery": _recovery_drill(n=n, window=window, batch=batch),
+        "crash": _crash_drill(n=n, window=window, batch=batch),
+    }
+
+
+def main() -> None:
+    """The `make durability-smoke` gate: hard assertions on all legs."""
+    out = summarize()
+    assert out["overhead"]["within_noise"], (
+        "group-commit WAL cost exceeded the WAL-off arm's noise floor: "
+        f"{out['overhead']!r}")
+    assert out["recovery"]["bounded_by_tail"], (
+        "checkpoint + tail replay did not beat the full rebuild: "
+        f"{out['recovery']!r}")
+    assert out["crash"]["recover_ms"] > 0
+    print(json.dumps({"durability_smoke": "ok", **out}))
+
+
+if __name__ == "__main__":
+    main()

@@ -533,7 +533,10 @@ def _code_metric_names(root: Path) -> set:
         for node in ast.walk(tree):
             if (isinstance(node, ast.Call)
                     and isinstance(node.func, ast.Attribute)
-                    and node.func.attr in ("inc", "timer", "histogram")
+                    and node.func.attr in ("inc", "timer", "histogram",
+                                           # the WAL's metrics-optional
+                                           # wrappers (durability/wal.py)
+                                           "_count", "_observe_ms")
                     and node.args
                     and isinstance(node.args[0], ast.Constant)
                     and isinstance(node.args[0].value, str)):

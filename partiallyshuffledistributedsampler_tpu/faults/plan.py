@@ -27,6 +27,9 @@ SITES = frozenset({
     "server.zombie_write",   # a fenced ex-primary refusing a client write
     "repl.append",           # the primary appending a WAL record
     "repl.promote",          # a standby promoting itself to primary
+    "wal.append",            # the durability WAL framing one record
+    "wal.fsync",             # the durability WAL syncing its segment
+    "wal.rotate",            # segment rollover / checkpoint GC truncation
     "client.leave",          # a client announcing its preemption drain
     "tenant.admission",      # a HELLO admitting / creating a tenant
     "loader.prefetch",       # one step of HostDataLoader's gather thread

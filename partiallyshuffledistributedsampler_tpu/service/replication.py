@@ -63,13 +63,23 @@ class ReplicationLog:
     Records are ``{"lsn": int, "op": str, **data}``; ``lsn`` is a dense
     1-based sequence.  ``append`` is the ``repl.append`` fault site: an
     injected failure marks the log for re-SYNC (the shipper re-ships the
-    full state) rather than surfacing into the serving path."""
+    full state) rather than surfacing into the serving path.
 
-    def __init__(self, metrics=None, tail: int = LOG_TAIL) -> None:
+    With a :class:`~..durability.WriteAheadLog` attached (``wal=``) the
+    in-memory deque becomes a *view* over the disk log: every record is
+    written through to the segments (under this log's lock — the WAL's
+    own lock nests inside it), ``lsn`` resumes from ``wal.last_lsn``
+    across restarts, and ``take()`` falls back to reading the segments
+    when the deque has rotated past a slow standby's cursor, so a
+    catch-up that used to force a full re-SYNC becomes a tail read."""
+
+    def __init__(self, metrics=None, tail: int = LOG_TAIL,
+                 wal=None) -> None:
         self._lock = new_lock("repl.log")
         self._cond = threading.Condition(self._lock)
         self._records: deque = deque(maxlen=max(1, int(tail)))  # guarded by: self._lock
-        self.lsn = 0               # guarded by: self._lock — last appended
+        self.wal = wal
+        self.lsn = wal.last_lsn if wal is not None else 0  # guarded by: self._lock — last appended
         self.resync_needed = False  # guarded by: self._lock
         self._urgent = False       # guarded by: self._lock — non-absorbing record pending
         self._metrics = metrics
@@ -95,6 +105,12 @@ class ReplicationLog:
             self.lsn += 1
             rec = {"lsn": self.lsn, "op": op, **data}
             self._records.append(rec)
+            if self.wal is not None:
+                # write-through: the WAL assigns noop fillers for any
+                # lsn a previously-injected fault dropped, keeping the
+                # on-disk sequence dense; a drop here degrades
+                # durability observably, never the serving path
+                self.wal.append(rec)
             # ``cursor`` upserts arrive once per served batch and are
             # absorbing (a newer one supersedes an older one for the
             # same rank), so they coalesce until the next ship tick
@@ -116,7 +132,10 @@ class ReplicationLog:
         because the batch's boundary lsns stay intact.  Returns
         ``(records, resync)``: ``resync`` True when the tail no longer
         reaches back to ``after_lsn + 1`` (or an append failed) and the
-        shipper must re-bootstrap."""
+        shipper must re-bootstrap.  With a ``wal`` attached, a deque
+        that rotated past the cursor first falls back to reading the
+        catch-up tail from the disk segments (``repl_wal_reads``);
+        only a tail the checkpoint GC already cut forces the re-SYNC."""
         with self._cond:
             if not self._urgent and not self.resync_needed:
                 self._cond.wait(timeout)
@@ -124,20 +143,28 @@ class ReplicationLog:
             if self.resync_needed:
                 return [], True
             recs = [r for r in self._records if r["lsn"] > after_lsn]
-            if recs and recs[0]["lsn"] != after_lsn + 1:
+            gap = ((bool(recs) and recs[0]["lsn"] != after_lsn + 1)
+                   or (not recs and self.lsn > after_lsn))
+        if gap:
+            if self.wal is None:
                 return [], True  # tail rotated past the standby's cursor
-            if not recs and self.lsn > after_lsn:
-                return [], True  # everything newer was already dropped
-            # upserts coalesce per (tenant, rank): a multi-tenant primary
-            # tags records with the owning tenant id, and two tenants'
-            # rank-0 cursors must not thin each other
-            newest_cursor = {
-                (r.get("tenant"), r["rank"]): r["lsn"]
-                for r in recs if r["op"] == "cursor"}
-            return [r for r in recs
-                    if r["op"] != "cursor"
-                    or newest_cursor[(r.get("tenant"), r["rank"])] == r["lsn"]
-                    ], False
+            # segment records are immutable once framed, so the read
+            # runs outside the log lock and never blocks appends
+            recs = self.wal.read_records(after_lsn=after_lsn)
+            if not recs or recs[0]["lsn"] != after_lsn + 1:
+                return [], True  # GC cut past the cursor: re-bootstrap
+            if self._metrics is not None:
+                self._metrics.inc("repl_wal_reads")
+        # upserts coalesce per (tenant, rank): a multi-tenant primary
+        # tags records with the owning tenant id, and two tenants'
+        # rank-0 cursors must not thin each other
+        newest_cursor = {
+            (r.get("tenant"), r["rank"]): r["lsn"]
+            for r in recs if r["op"] == "cursor"}
+        return [r for r in recs
+                if r["op"] != "cursor"
+                or newest_cursor[(r.get("tenant"), r["rank"])] == r["lsn"]
+                ], False
 
     def clear_resync(self) -> None:
         with self._cond:
@@ -159,6 +186,12 @@ class TenantTaggedLog:
 
     def append(self, op: str, data: dict) -> None:
         self._log.append(op, {**data, "tenant": self.tenant})
+
+    @property
+    def lsn(self) -> int:
+        """The shared sequence's last lsn — a tenant engine's seal
+        stamps it as the checkpoint watermark (``wal_lsn``)."""
+        return self._log.lsn
 
 
 class ReplicationShipper:

@@ -102,6 +102,8 @@ import numpy as np
 from .. import faults as F
 from ..analysis.lockorder import new_lock
 from .. import telemetry
+from ..durability import FsyncPolicy, WriteAheadLog
+from ..durability.recover import replay_wal_tail
 from ..telemetry import annotate as _annotate, span as _span
 from ..tenancy import FairShareScheduler, TenantQuota, tenant_id_for
 from ..utils.checkpoint import (
@@ -174,6 +176,8 @@ class IndexServer:
         max_tenants: int = 8,
         tenant_quota: Optional[TenantQuota] = None,
         regen_scheduler: Optional[FairShareScheduler] = None,
+        wal_dir: Optional[str] = None,
+        fsync: str = "group_commit",
     ) -> None:
         if max_inflight < 1:
             raise ValueError(f"max_inflight must be >= 1, got {max_inflight}")
@@ -255,6 +259,17 @@ class IndexServer:
         self._feed_last: Optional[float] = None
         self._primary_addr = None       # learned from REPL_SYNC
         self._seal_pending = False
+        # ---- durability (docs/RESILIENCE.md "Durability & recovery") ----
+        #: segment-WAL directory; None keeps the pre-durability behavior
+        #: (in-memory replication log only, full-snapshot restores)
+        self.wal_dir = wal_dir
+        #: parsed eagerly so a bad policy string fails construction,
+        #: not the first append
+        self.fsync_policy = FsyncPolicy.parse(fsync)
+        self._wal: Optional[WriteAheadLog] = None
+        #: the WAL lsn the restored snapshot checkpoint reflects —
+        #: recovery replays the tail strictly above it
+        self._ckpt_lsn = 0  # guarded by: self._lock
         # ---- multi-tenancy (docs/SERVICE.md "Tenancy") ----
         #: this server's own namespace id — the world-stripped spec
         #: fingerprint hashed down to a short wire/file-safe token.  A
@@ -297,10 +312,7 @@ class IndexServer:
             raise RuntimeError("server already started")
         self._stop.clear()
         self._draining.clear()
-        if self.snapshot_path and os.path.exists(self.snapshot_path):
-            self._restore(load_sampler_state(self.snapshot_path))
-        if self.multi_tenant and self.snapshot_path:
-            self._restore_tenants()
+        self._recover_from_disk()
         ls = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         ls.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         ls.bind((self.host, self.port))
@@ -312,19 +324,25 @@ class IndexServer:
                              name="psds-service-accept")
         t.start()
         self._threads.append(t)
-        if self.role == "primary" and self._standby_addr is not None:
-            self._repl_log = ReplicationLog(metrics=self.metrics)
+        if self.role == "primary" and (self._standby_addr is not None
+                                       or self._wal is not None):
+            # the log exists whenever there is somewhere for records to
+            # go: a standby to ship to, a WAL to write through to, or
+            # both (then they share one lsn sequence)
+            self._repl_log = ReplicationLog(metrics=self.metrics,
+                                            wal=self._wal)
             for eng in self._engines():
                 eng._repl_log = TenantTaggedLog(self._repl_log,
                                                 eng.tenant_id)
-            self._shipper = ReplicationShipper(
-                self._repl_log, self._standby_addr,
-                state_fn=self._repl_sync_state,
-                term_fn=lambda: self.term,
-                on_fenced=self._fence,
-                metrics=self.metrics,
-            )
-            self._shipper.start()
+            if self._standby_addr is not None:
+                self._shipper = ReplicationShipper(
+                    self._repl_log, self._standby_addr,
+                    state_fn=self._repl_sync_state,
+                    term_fn=lambda: self.term,
+                    on_fenced=self._fence,
+                    metrics=self.metrics,
+                )
+                self._shipper.start()
         return self.host, self.port
 
     @property
@@ -385,6 +403,11 @@ class IndexServer:
             eng._stop.set()
             eng._write_snapshot(force=True)
         self._write_snapshot(force=True)
+        wal, self._wal = self._wal, None
+        if wal is not None:
+            for eng in self._engines():
+                eng._wal = None
+            wal.close(sync=True)
 
     def kill(self) -> None:
         """Abrupt death for failover drills: the ``kill -9`` a ``stop()``
@@ -415,6 +438,14 @@ class IndexServer:
         for t in self._threads:
             t.join(timeout=1.0)
         self._threads.clear()
+        # no final sync: a killed host never got one either.  The close
+        # only drops the handle; whatever the fsync policy had already
+        # made durable is what recovery will see
+        wal, self._wal = self._wal, None
+        if wal is not None:
+            for eng in self._engines():
+                eng._wal = None
+            wal.close(sync=False)
 
     def __enter__(self) -> "IndexServer":
         self.start()
@@ -464,12 +495,18 @@ class IndexServer:
         eng.term = self.term
         if self._repl_log is not None:
             eng._repl_log = TenantTaggedLog(self._repl_log, tid)
+        if self._wal is not None:
+            # the engine shares the front's WAL: its records are
+            # tenant-tagged in the same lsn sequence, and registering it
+            # as an owner pins GC until it has sealed twice itself
+            eng._wal = self._wal
+            self._wal.register_owner(tid)
         if self._regen_sched is not None:
             self._regen_sched.set_quota(tid, weight=q.weight,
                                         concurrency=q.regen_concurrency)
         if eng.snapshot_path and os.path.exists(eng.snapshot_path):
             try:
-                eng._restore(load_sampler_state(eng.snapshot_path))
+                eng._restore_from_disk()
             except (OSError, ValueError, KeyError) as exc:
                 warnings.warn(
                     f"IndexServer: tenant snapshot {eng.snapshot_path!r} "
@@ -493,10 +530,22 @@ class IndexServer:
                 spec = PartialShuffleSpec.from_wire(
                     st["spec"], backend=self.spec.backend)
             except (OSError, ValueError, KeyError) as exc:
-                warnings.warn(
-                    f"IndexServer: tenant snapshot {path!r} unreadable "
-                    f"({exc!r}); skipped", RuntimeWarning)
-                continue
+                # with a WAL the previous checkpoint can still name the
+                # tenant's spec; _make_tenant_engine then restores from
+                # it through the same fallback path
+                spec = None
+                if self._wal is not None and os.path.exists(path + ".prev"):
+                    try:
+                        st = load_sampler_state(path + ".prev")
+                        spec = PartialShuffleSpec.from_wire(
+                            st["spec"], backend=self.spec.backend)
+                    except (OSError, ValueError, KeyError):
+                        spec = None
+                if spec is None:
+                    warnings.warn(
+                        f"IndexServer: tenant snapshot {path!r} unreadable "
+                        f"({exc!r}); skipped", RuntimeWarning)
+                    continue
             fp = spec.fingerprint(include_world=False)
             if fp == own:
                 continue
@@ -570,6 +619,12 @@ class IndexServer:
             "leases": {str(r): int(l.get("batch") or 0)
                        for r, l in self._leases.items()},
         }
+        if self._wal is not None and self._repl_log is not None:
+            # the WAL position this snapshot reflects — recovery
+            # replays the tail strictly above it.  Exact: every append
+            # happens under this same lock, so nothing can slip between
+            # reading the lsn and sealing the state
+            state["wal_lsn"] = int(self._repl_log.lsn)
         rs = self._reshard
         if rs is not None and rs.get("phase") == "drain":
             state["reshard"] = {
@@ -584,7 +639,70 @@ class IndexServer:
             }
         return state
 
-    def _restore(self, state: dict) -> None:
+    def _recover_from_disk(self) -> dict:
+        """The restart-time recovery sequence (docs/RESILIENCE.md
+        "Durability & recovery"): open the WAL — a torn tail is
+        detected and cut there — restore the newest readable snapshot
+        checkpoint, rediscover tenant snapshots, then replay the WAL
+        tail above each owner's watermark through the same record path
+        a hot standby applies.  Runs before the socket binds;
+        :func:`~..durability.recover_unstarted` drives it directly for
+        the crash matrix.  Returns the replay stats dict."""
+        if (self.wal_dir is not None and self.role == "primary"
+                and self._wal is None):
+            self._wal = WriteAheadLog(self.wal_dir,
+                                      fsync=self.fsync_policy,
+                                      metrics=self.metrics)
+            self._wal.register_owner(self.tenant_id)
+            for eng in self._engines():
+                # same-instance restart: engines re-attach to the
+                # reopened log (their old handle was closed at stop)
+                eng._wal = self._wal
+                self._wal.register_owner(eng.tenant_id)
+        self._restore_from_disk()
+        if self.multi_tenant and self.snapshot_path:
+            self._restore_tenants()
+        if self._wal is None:
+            return {"replayed": 0, "skipped": 0, "last_lsn": 0,
+                    "replay_ms": 0.0}
+        return replay_wal_tail(self)
+
+    def _restore_from_disk(self) -> None:
+        """Restore from ``snapshot_path``.  Without a WAL this is the
+        pre-durability behavior: the one snapshot either restores or —
+        on a CRC failure — is refused loudly and the server starts
+        fresh.  With a WAL, a corrupt or unreadable newest checkpoint
+        falls back to its retained ``.prev`` predecessor plus a longer
+        tail replay (counted as ``snapshot_fallbacks``); only when
+        neither is readable does the state rebuild from lsn 0."""
+        if not (self.snapshot_path and os.path.exists(self.snapshot_path)):
+            return
+        if self._wal is None:
+            self._restore(load_sampler_state(self.snapshot_path))
+            return
+        for fallback, path in enumerate(
+                (self.snapshot_path, self.snapshot_path + ".prev")):
+            if not os.path.exists(path):
+                continue
+            try:
+                state = load_sampler_state(path)
+            except (OSError, ValueError) as exc:
+                warnings.warn(
+                    f"IndexServer: checkpoint {path!r} unreadable "
+                    f"({exc!r}); trying the previous one", RuntimeWarning)
+                continue
+            if self._restore(state):
+                if fallback:
+                    self.metrics.inc("snapshot_fallbacks")
+                    warnings.warn(
+                        f"IndexServer: newest checkpoint was refused; "
+                        f"fell back to {path!r} — the WAL replay covers "
+                        "the difference", RuntimeWarning)
+                return
+        # neither checkpoint readable: the WAL replay rebuilds the
+        # operational state from lsn 0
+
+    def _restore(self, state: dict) -> bool:
         crc = state.get("crc32")
         if crc is not None and _state_crc(state) != int(crc):
             # a torn/corrupted snapshot must be refused, not half-loaded:
@@ -597,7 +715,7 @@ class IndexServer:
                 f"{_state_crc(state)}); refusing the corrupted snapshot "
                 "and starting fresh", RuntimeWarning,
             )
-            return
+            return False
         if state.get("kind") != SNAPSHOT_KIND:
             raise ValueError(
                 f"snapshot kind {state.get('kind')!r} is not a "
@@ -622,6 +740,7 @@ class IndexServer:
             )
         with self._lock:
             self.epoch = int(state.get("epoch", 0))
+            self._ckpt_lsn = int(state.get("wal_lsn", 0))
             self._cursors = {
                 int(r): {"epoch": int(c["epoch"]), "acked": int(c["acked"]),
                          "hi": int(c["hi"]),
@@ -629,7 +748,7 @@ class IndexServer:
                 for r, c in state.get("cursors", {}).items()
             }
             if fmt < 2:
-                return
+                return True
             self.generation = int(state.get("generation", 0))
             self.term = max(self.term, int(state.get("term", 0)))
             for r, b in (state.get("leases") or {}).items():
@@ -668,6 +787,7 @@ class IndexServer:
                     if (r not in self._reshard["drained"]
                             and r not in self._reshard["dead"]):
                         self._vacated.setdefault(r, now)
+        return True
 
     def _write_snapshot(self, force: bool = False) -> None:
         if not self.snapshot_path:
@@ -679,13 +799,29 @@ class IndexServer:
             self._unsnapshotted = 0
         state = self._state_dict()
         state["crc32"] = _state_crc(state)
+        wal = self._wal
         try:
             F.fire("server.snapshot_write")
+            if wal is not None and os.path.exists(self.snapshot_path):
+                # previous-checkpoint retention: keep the predecessor so
+                # a corrupt newest snapshot can fall back to it plus a
+                # longer WAL replay (``snapshot_fallbacks``)
+                os.replace(self.snapshot_path,
+                           self.snapshot_path + ".prev")
             save_sampler_state(self.snapshot_path, state, durable=True)
             if self._repl_log is not None:
                 # the seal marks the durable point in the WAL: a standby
                 # with its own snapshot_path persists at the same cadence
                 self._repl_log.append("seal", {})
+            if wal is not None:
+                # the seal is an incremental checkpoint: record this
+                # owner's watermark and GC segments every owner has
+                # checkpointed past (twice — previous retention)
+                wal.sync()
+                wal.checkpoint(self.tenant_id,
+                               int(state.get("wal_lsn", 0)))
+                with self._lock:
+                    self._ckpt_lsn = int(state.get("wal_lsn", 0))
         except OSError as exc:
             # The snapshot is operational state, never a correctness
             # dependency (streams are pure functions of the spec) — a
@@ -2007,8 +2143,10 @@ class IndexServer:
                 cur = self._cursors[rank] = {"epoch": epoch, "acked": -1,
                                              "hi": -1, "samples": 0}
             ack = header.get("ack")
-            if ack is not None:
-                cur["acked"] = max(cur["acked"], int(ack))
+            acked_advanced = False
+            if ack is not None and int(ack) > cur["acked"]:
+                cur["acked"] = int(ack)
+                acked_advanced = True
             if seq > cur["acked"] + self.max_inflight:
                 self.metrics.inc("throttled", rank)
                 _annotate(error_code="throttle")
@@ -2083,6 +2221,15 @@ class IndexServer:
         total = int(arr.shape[0])
         limit = total if clamp is None else min(clamp, total)
         if lo >= limit:
+            if acked_advanced:
+                # the epoch's terminal ack rides the EOF poll and no
+                # slice is served below, so the usual served-slice
+                # cursor append never runs — persist the advance here
+                # or recovery resumes one ack behind
+                with self._lock:
+                    cur = self._cursors.get(rank)
+                    if cur is not None and cur["epoch"] == epoch:
+                        self._repl_append("cursor", rank=rank, **cur)
             P.send_msg(sock, P.MSG_BATCH,
                        {"seq": seq, "eof": True, "total": total,
                         "end": limit, "gen": gen})

@@ -25,6 +25,13 @@ from typing import Optional
 from ..analysis.lockorder import new_lock
 
 
+def _fsync_fileobj(f) -> None:
+    # deferred: utils.retry imports telemetry, so a module-level import
+    # of utils.checkpoint here would be circular
+    from ..utils.checkpoint import fsync_fileobj
+    fsync_fileobj(f)
+
+
 def _prom_name(prefix: str, name: str) -> str:
     out = []
     for ch in f"{prefix}_{name}" if prefix else name:
@@ -86,13 +93,18 @@ class JsonlSink:
     Entries accumulate in memory and are flushed when ``batch`` entries
     are pending or ``interval_s`` has elapsed since the last flush,
     whichever comes first.  ``close()`` flushes the tail; the sink is
-    also a context manager."""
+    also a context manager.  ``durable=True`` fsyncs on every explicit
+    ``flush()``/``close()`` (through the same
+    :func:`~..utils.checkpoint.fsync_fileobj` primitive the snapshots
+    use), so the telemetry written just before a host dies survives it
+    — the interval/batch flushes stay cheap page-cache writes."""
 
     def __init__(self, path: str, interval_s: float = 2.0,
-                 batch: int = 64) -> None:
+                 batch: int = 64, durable: bool = False) -> None:
         self.path = str(path)
         self.interval_s = float(interval_s)
         self.batch = max(1, int(batch))
+        self.durable = bool(durable)
         self._lock = new_lock("telemetry.sink")
         self._buf: list[str] = []
         self._last_flush = time.monotonic()
@@ -111,6 +123,8 @@ class JsonlSink:
     def flush(self) -> None:
         with self._lock:
             self._flush_locked()
+            if self.durable and not self._f.closed:
+                _fsync_fileobj(self._f)
 
     def _flush_locked(self) -> None:
         if self._buf:
@@ -124,6 +138,8 @@ class JsonlSink:
         with self._lock:
             if not self._f.closed:
                 self._flush_locked()
+                if self.durable:
+                    _fsync_fileobj(self._f)
                 self._f.close()
 
     def __enter__(self) -> "JsonlSink":

@@ -90,20 +90,22 @@ class FlightRecorder:
             self._dump_seq += 1
             seq = self._dump_seq
             dropped = self.dropped
-        tmp = f"{path}.tmp.{os.getpid()}"
-        with open(tmp, "w", encoding="utf-8") as f:
-            f.write(json.dumps({
-                "kind": "flight_dump", "reason": str(reason), "seq": seq,
-                "wall": round(self.clock(), 3), "entries": len(entries),
-                "open_spans": len(extra_entries), "dropped": dropped,
-            }, separators=(",", ":")) + "\n")
-            for e in entries:
-                f.write(json.dumps(e, separators=(",", ":"),
-                                   default=repr) + "\n")
-            for e in extra_entries:
-                f.write(json.dumps(e, separators=(",", ":"),
-                                   default=repr) + "\n")
-        os.replace(tmp, path)  # dumps appear atomically, never half-written
+        lines = [json.dumps({
+            "kind": "flight_dump", "reason": str(reason), "seq": seq,
+            "wall": round(self.clock(), 3), "entries": len(entries),
+            "open_spans": len(extra_entries), "dropped": dropped,
+        }, separators=(",", ":"))]
+        for e in entries:
+            lines.append(json.dumps(e, separators=(",", ":"), default=repr))
+        for e in extra_entries:
+            lines.append(json.dumps(e, separators=(",", ":"), default=repr))
+        # the same atomic write+fsync path the snapshots use: a
+        # post-mortem written milliseconds before the host dies must
+        # actually survive it, not sit in the page cache.  Deferred
+        # import: utils.retry imports telemetry, so a module-level one
+        # would be circular
+        from ..utils.checkpoint import durable_write_text
+        durable_write_text(path, "\n".join(lines) + "\n")
         return path
 
     def auto_dump(self, reason: str, extra_entries=()) -> Optional[str]:

@@ -4,6 +4,12 @@ The sampler's whole state is the `(spec_version, seed, epoch, offset)` dict
 from ``state_dict()`` — a plain pytree of scalars, so it drops directly into
 any checkpointing system (orbax `save_pytree`, torch ``torch.save`` training
 state, or these json helpers for standalone use).
+
+:func:`durable_write_text` / :func:`fsync_fileobj` are the shared
+write+fsync primitives — the snapshot path, the flight recorder's crash
+dumps, and the telemetry JSONL sink all persist through them, so "what
+survives a host dying right after the write returned" has exactly one
+answer in this codebase.
 """
 
 from __future__ import annotations
@@ -13,21 +19,28 @@ import os
 import tempfile
 
 
-def save_sampler_state(path: str, state: dict, *, durable: bool = False) -> None:
-    """Atomic json write (rename over), safe against mid-write crashes.
+def fsync_fileobj(f) -> None:
+    """Flush ``f``'s userspace buffer and fsync its descriptor: after
+    this returns, the bytes written so far survive a power loss (the
+    plain ``flush()`` alone only hands them to the OS page cache)."""
+    f.flush()
+    os.fsync(f.fileno())
 
-    ``durable=True`` additionally fsyncs the temp file before the rename
-    and the directory after it, so the rename itself survives a power
-    loss — without it the atomic rename only protects against *process*
-    crashes (the OS may reorder the data and rename writes on disk)."""
+
+def durable_write_text(path: str, text: str, *, durable: bool = True) -> None:
+    """Atomic whole-file write (temp file, rename over), safe against
+    mid-write crashes.  ``durable=True`` additionally fsyncs the temp
+    file before the rename and the directory after it, so the rename
+    itself survives a power loss — without it the atomic rename only
+    protects against *process* crashes (the OS may reorder the data and
+    rename writes on disk)."""
     d = os.path.dirname(os.path.abspath(path)) or "."
     fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
     try:
         with os.fdopen(fd, "w") as f:
-            json.dump(state, f)
+            f.write(text)
             if durable:
-                f.flush()
-                os.fsync(f.fileno())
+                fsync_fileobj(f)
         os.replace(tmp, path)
         if durable:
             dfd = os.open(d, os.O_RDONLY)
@@ -39,6 +52,13 @@ def save_sampler_state(path: str, state: dict, *, durable: bool = False) -> None
         if os.path.exists(tmp):
             os.unlink(tmp)
         raise
+
+
+def save_sampler_state(path: str, state: dict, *, durable: bool = False) -> None:
+    """Atomic json write (rename over) via :func:`durable_write_text`;
+    ``durable=True`` makes the write power-loss safe, not just
+    process-crash safe."""
+    durable_write_text(path, json.dumps(state), durable=durable)
 
 
 def load_sampler_state(path: str) -> dict:

@@ -36,7 +36,8 @@ from partiallyshuffledistributedsampler_tpu.analysis import lockorder  # noqa: E
 
 #: tests in these groups drive the threaded service stack and must not
 #: leave non-daemon threads behind (docs/ANALYSIS.md "Thread-leak gate")
-_LEAK_CHECKED_MARKS = ("failover", "tenancy", "chaos", "elastic", "telemetry")
+_LEAK_CHECKED_MARKS = ("failover", "tenancy", "chaos", "elastic",
+                       "telemetry", "durability")
 
 
 @pytest.fixture(autouse=True)

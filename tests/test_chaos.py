@@ -22,6 +22,7 @@ import numpy as np
 import pytest
 
 from partiallyshuffledistributedsampler_tpu import faults as F
+from partiallyshuffledistributedsampler_tpu.durability import WriteAheadLog
 from partiallyshuffledistributedsampler_tpu.ops.mixture import MixtureSpec
 from partiallyshuffledistributedsampler_tpu.sampler.host_loader import (
     HostDataLoader,
@@ -667,3 +668,145 @@ def test_zombie_write_refusal_survives_injected_fault():
             primary.stop()
             standby.stop()
     assert plan.fired("server.zombie_write") > 0, "fault never fired"
+
+
+# --------------------------------------------------- durability WAL faults
+def _wal_records(wal_dir):
+    w = WriteAheadLog(wal_dir, fsync="off")
+    try:
+        return w.read_records()
+    finally:
+        w.close(sync=False)
+
+
+def test_wal_torn_append_degrades_never_the_stream(tmp_path):
+    """A torn frame mid-append leaves a REAL torn tail on disk and
+    degrades the WAL — the client's stream stays bit-identical, and the
+    next restart cuts the tear and serves again."""
+    spec = plain_spec(world=1)
+    ref = np.asarray(spec.rank_indices(0, 0))
+    wal_dir = str(tmp_path / "wal")
+    plan = F.FaultPlan([F.FaultRule(site="wal.append", kind="torn_frame",
+                                    nth=3, count=1)])
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        with plan:
+            with IndexServer(spec, wal_dir=wal_dir) as srv:
+                with ServiceIndexClient(srv.address, rank=0,
+                                        batch=37) as client:
+                    got = client.epoch_indices(0)
+    assert plan.fired("wal.append") == 1, "fault never fired; vacuous"
+    assert np.array_equal(got, ref), "stream diverged under wal.append"
+    assert srv.metrics.report()["counters"].get("wal_append_errors", 0) >= 1
+    assert any("torn frame" in str(w.message) for w in caught)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        with IndexServer(plain_spec(world=1), wal_dir=wal_dir) as srv2:
+            with ServiceIndexClient(srv2.address, rank=0,
+                                    batch=37) as client:
+                assert np.array_equal(client.epoch_indices(0), ref)
+    assert srv2.metrics.report()["counters"].get("wal_torn_tails", 0) >= 1
+    assert any("torn tail" in str(w.message) for w in caught)
+
+
+def test_wal_fsync_fault_does_not_stop_serving(tmp_path):
+    """Every fsync failing costs durability (counted), never a byte of
+    the stream — and the records still reach the page cache, so a clean
+    shutdown leaves a fully replayable log."""
+    spec = plain_spec(world=1)
+    ref = np.asarray(spec.rank_indices(0, 0))
+    wal_dir = str(tmp_path / "wal")
+    plan = F.FaultPlan([F.FaultRule(site="wal.fsync", kind="error",
+                                    count=0)])
+    with warnings.catch_warnings(), plan:
+        warnings.simplefilter("ignore")
+        with IndexServer(spec, wal_dir=wal_dir,
+                         fsync="per_record") as srv:
+            with ServiceIndexClient(srv.address, rank=0,
+                                    batch=37) as client:
+                got = client.epoch_indices(0)
+    assert plan.fired("wal.fsync") >= 1, "fault never fired; vacuous"
+    assert np.array_equal(got, ref), "stream diverged under wal.fsync"
+    assert srv.metrics.report()["counters"].get("wal_fsync_errors", 0) >= 1
+    recs = _wal_records(wal_dir)
+    assert recs and [r["lsn"] for r in recs] == \
+        list(range(1, len(recs) + 1))
+
+
+def test_wal_rotate_disk_full_keeps_appending(tmp_path):
+    """A failed segment rollover keeps appending to the full segment
+    (bounded growth beats lost records); every record stays readable
+    and later rollovers succeed."""
+    from partiallyshuffledistributedsampler_tpu.service.metrics import (
+        ServiceMetrics,
+    )
+    m = ServiceMetrics()
+    w = WriteAheadLog(str(tmp_path / "wal"), fsync="off",
+                      segment_bytes=200, metrics=m)
+    plan = F.FaultPlan([F.FaultRule(site="wal.rotate", kind="disk_full",
+                                    nth=1, count=1)])
+    with plan:
+        for i in range(1, 31):
+            assert w.append({"lsn": i, "op": "epoch", "epoch": i})
+    assert plan.fired("wal.rotate") == 1, "fault never fired; vacuous"
+    assert [r["lsn"] for r in w.read_records()] == list(range(1, 31))
+    counters = m.report()["counters"]
+    assert counters.get("wal_rotate_errors", 0) == 1
+    assert counters.get("wal_rotations", 0) >= 1, "later rollovers healed"
+    w.close()
+
+
+def test_wal_gc_abort_between_seal_and_truncate(tmp_path):
+    """A crash between the checkpoint seal and the segment truncation
+    (injected at the GC's wal.rotate site) only delays reclamation:
+    every record is still readable, and the next seal truncates."""
+    w = WriteAheadLog(str(tmp_path / "wal"), fsync="off",
+                      segment_bytes=200)
+    for i in range(1, 61):
+        w.append({"lsn": i, "op": "epoch", "epoch": i})
+    w.register_owner("front")
+    w.checkpoint("front", 30)
+    nseg = len(w.segment_paths())
+    plan = F.FaultPlan([F.FaultRule(site="wal.rotate", kind="error",
+                                    nth=1, count=1)])
+    with plan:  # armed ONLY around the seal: rollovers must not consume it
+        assert w.checkpoint("front", 50) == 0
+    assert plan.fired("wal.rotate") == 1, "fault never fired; vacuous"
+    assert len(w.segment_paths()) == nseg, "aborted GC must not truncate"
+    assert [r["lsn"] for r in w.read_records()] == list(range(1, 61))
+    assert w.checkpoint("front", 55) > 0, "the next seal retries the GC"
+    assert [r["lsn"] for r in w.read_records(after_lsn=50)] == \
+        list(range(51, 61))
+    w.close()
+
+
+def test_wal_append_disk_full_recovery_stays_dense(tmp_path):
+    """Two dropped appends (injected ENOSPC) leave holes that the next
+    successful append noop-fills: the stream is untouched, the on-disk
+    sequence stays dense, and a restarted daemon recovers and serves
+    bit-identically."""
+    spec = plain_spec(world=1)
+    ref = np.asarray(spec.rank_indices(0, 0))
+    wal_dir = str(tmp_path / "wal")
+    plan = F.FaultPlan([F.FaultRule(site="wal.append", kind="disk_full",
+                                    nth=2, count=2)])
+    with plan:
+        srv = IndexServer(spec, wal_dir=wal_dir)
+        srv.start()
+        with ServiceIndexClient(srv.address, rank=0, batch=37) as client:
+            got = client.epoch_indices(0)
+        srv.kill()
+    assert plan.fired("wal.append") == 2, "fault never fired; vacuous"
+    assert np.array_equal(got, ref), "stream diverged under wal.append"
+    assert srv.metrics.report()["counters"].get("wal_append_errors", 0) == 2
+    recs = _wal_records(wal_dir)
+    assert [r["lsn"] for r in recs] == list(range(1, len(recs) + 1))
+    assert [r["op"] for r in recs].count("noop") == 2
+    srv2 = IndexServer(plain_spec(world=1), wal_dir=wal_dir)
+    srv2.start()
+    try:
+        with ServiceIndexClient(srv2.address, rank=0, batch=37) as client:
+            assert np.array_equal(client.epoch_indices(0), ref)
+    finally:
+        srv2.stop()
+    assert srv2.metrics.report()["counters"].get("wal_recoveries", 0) == 1

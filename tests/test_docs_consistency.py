@@ -185,3 +185,32 @@ def test_tenancy_doc_cross_linked():
         assert token in obs, (
             f"docs/OBSERVABILITY.md lost the per-tenant metric `{token}`"
         )
+
+
+def test_durability_doc_cross_linked():
+    """The durability surface is documented where an operator would
+    look: RESILIENCE.md owns the WAL/checkpoint/recovery story (fsync
+    policies, crash-matrix contract, the make gate), API.md documents
+    the knobs, OBSERVABILITY.md the metric names."""
+    res = (DOCS / "RESILIENCE.md").read_text()
+    assert "## Durability & recovery" in res, (
+        "docs/RESILIENCE.md lost its Durability & recovery section")
+    for token in ("wal_dir", "group_commit", "per_record", "wal_lsn",
+                  "check_invariants", "durability-smoke",
+                  "kill-at-any-byte"):
+        assert token in res, f"docs/RESILIENCE.md Durability lost `{token}`"
+    api = API_MD.read_text()
+    for token in ("wal_dir=None", "fsync='group_commit'",
+                  "durable=False"):
+        assert token in api, f"docs/API.md lost the durability knob `{token}`"
+    obs = OBSERVABILITY_MD.read_text()
+    for token in ("wal_torn_tails", "wal_segments_gced", "wal_recoveries",
+                  "snapshot_fallbacks", "repl_wal_reads", "wal_fsync_ms",
+                  "recovery_replay_ms"):
+        assert token in obs, (
+            f"docs/OBSERVABILITY.md lost the durability metric `{token}`")
+    # the documented fault sites must be the registered ones
+    from partiallyshuffledistributedsampler_tpu import faults as F
+
+    for site in ("wal.append", "wal.fsync", "wal.rotate"):
+        assert site in F.SITES and site in res
