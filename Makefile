@@ -47,6 +47,11 @@
 #                          WAL: kill-at-any-byte crash matrix, torn-tail
 #                          goldens, checkpoint fallback, then the WAL-
 #                          overhead + recovery-bounded-by-tail bars
+#   * fused smoke          tests/test_fused.py (`-m fused`)
+#                          + benchmarks/fused_smoke.py — pipelined serve
+#                          path: lookahead-vs-guarded bit-identity across
+#                          epoch boundaries/reshard/failover, then the
+#                          fusion-speedup + boundary-overlap bars
 #   * analyze              project-native static analysis (docs/ANALYSIS.md):
 #                          guarded-by discipline, fault-site/protocol/
 #                          metrics-docs drift, clock discipline, silent-
@@ -60,7 +65,7 @@ PY ?= python
 
 .PHONY: check test bench native dryrun service-smoke chaos-smoke \
 	elastic-smoke telemetry-smoke failover-smoke tenancy-smoke \
-	durability-smoke analyze analysis-smoke
+	durability-smoke fused-smoke analyze analysis-smoke
 
 # the driver parses the LAST line of bench.py's combined output (round 3
 # lost its headline to the details line — BENCH_r03.json "parsed": null),
@@ -129,6 +134,15 @@ tenancy-smoke:
 durability-smoke:
 	$(PY) -m pytest tests/test_durability.py -q -m durability -ra
 	$(PY) benchmarks/durability_smoke.py
+
+# serve-path fusion gate (docs/SERVICE.md "Serve-path fusion"): the
+# pipelined-client suite (lookahead across epoch boundaries, reshard
+# freeze, failover — prefetched-but-unacked batches replayed exactly
+# once, bit-identical in every stream mode), then the fused-vs-guarded
+# speedup + boundary-prefetch overlap smoke
+fused-smoke:
+	$(PY) -m pytest tests/test_fused.py -q -m fused -ra
+	$(PY) benchmarks/fused_smoke.py
 
 # static-analysis gate (docs/ANALYSIS.md): every lint pass over the
 # package + docs; any finding is a non-zero exit with file:line output

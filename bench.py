@@ -435,6 +435,20 @@ def main() -> None:
         except Exception as exc:
             details["tenancy_error"] = repr(exc)[:200]
 
+    # detail tier: fused — pipelined (lookahead=4) vs guarded serve
+    # wall per step, bit-identical streams, and the loader's boundary-
+    # prefetch epoch gap (methodology in benchmarks/fused_smoke.py)
+    if not smoke:
+        try:
+            sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+            from benchmarks.fused_smoke import (
+                summarize as fused_summarize,
+            )
+
+            details["fused"] = fused_summarize()
+        except Exception as exc:
+            details["fused_error"] = repr(exc)[:200]
+
     # detail tier: analysis — concurrency-sanitizer overhead: the
     # tracked-lock arm must stay within the raw-lock arm's rep noise
     # and record zero lock-order cycles (methodology in

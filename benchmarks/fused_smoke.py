@@ -1,0 +1,164 @@
+"""Serve-path fusion smoke: the pipelined client must beat (or at worst
+match) the guarded request-reply path, bit-identically, and the loader's
+boundary prefetch must shrink the epoch gap.
+
+Two consumers:
+
+* ``make fused-smoke`` / ``python benchmarks/fused_smoke.py`` — the CI
+  gate: assert the pipelined (``lookahead=4``) stream is bit-identical
+  to the guarded (``lookahead=1``) stream, that pipelining costs no
+  more than the guarded arm's own rep-to-rep noise
+  (``fused_within_noise`` — on loopback the round trips it hides are
+  microseconds, so the honest CI bar is "never slower", while the
+  speedup itself is the headline on real networks), and that the
+  boundary-prefetched first batch arrives within noise of the
+  steady-state step (``boundary_overlap_within_noise``).  Exit 0 and
+  one JSON line on success; raises loudly otherwise.
+
+* ``bench.py`` imports :func:`summarize` for ``details["fused"]``.
+
+Methodology mirrors telemetry_smoke: one :class:`IndexServer`, the two
+arms alternated per rep so machine drift hits both equally, medians
+over ``reps``, and the noise floor is the guarded arm's max−min spread
+with a small absolute floor (docs/SERVICE.md "Serve-path fusion").
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+#: loopback rep spread can be ~0; keep slack for scheduler jitter
+#: between the alternated arms (ms per GET_BATCH step)
+_NOISE_FLOOR_MS_PER_STEP = 0.05
+
+#: absolute floor for the boundary-gap bar (ms): a prefetched boundary
+#: still pays one cache-dict hit plus generator setup
+_NOISE_FLOOR_BOUNDARY_MS = 2.0
+
+
+def _epoch_wall_ms(client, epoch: int):
+    t0 = time.perf_counter()
+    got = np.concatenate(list(client.epoch_batches(epoch)))
+    return (time.perf_counter() - t0) * 1e3, got
+
+
+def _serve_arms(n: int, window: int, batch: int, reps: int) -> dict:
+    """Guarded (lookahead=1) vs pipelined (lookahead=4) epoch wall."""
+    from partiallyshuffledistributedsampler_tpu.service import (
+        IndexServer,
+        PartialShuffleSpec,
+        ServiceIndexClient,
+    )
+
+    spec = PartialShuffleSpec.plain(n, window=window, seed=0, world=1)
+    ref = np.asarray(spec.rank_indices(1, 0))
+    steps = -(-n // batch)
+    guarded_ms, fused_ms = [], []
+    rpcs = 0
+    with IndexServer(spec) as srv:
+
+        def one(lookahead: int):
+            # the rank lease is exclusive, so the arms alternate by
+            # reconnecting; the measured section is the epoch stream only
+            nonlocal rpcs
+            with ServiceIndexClient(srv.address, rank=0, batch=batch,
+                                    lookahead=lookahead) as c:
+                ms, got = _epoch_wall_ms(c, 1)
+                if lookahead > 1:
+                    rpcs = int(c.metrics.report()
+                               .get("counters", {})
+                               .get("rpcs_per_step", 0))
+            return ms, got
+
+        one(1)  # warm the server's epoch cache
+        for _ in range(reps):
+            ms, got_g = one(1)
+            guarded_ms.append(ms)
+            ms, got_f = one(4)
+            fused_ms.append(ms)
+    if not (np.array_equal(got_g, ref) and np.array_equal(got_f, ref)):
+        raise AssertionError(
+            "pipelined stream diverged from the guarded/reference "
+            "stream — fusion must never change the data")
+    g_med, f_med = float(np.median(guarded_ms)), float(np.median(fused_ms))
+    noise = max((max(guarded_ms) - min(guarded_ms)) / steps,
+                _NOISE_FLOOR_MS_PER_STEP)
+    return {
+        "steps": steps,
+        "guarded_ms_per_step": round(g_med / steps, 5),
+        "fused_ms_per_step": round(f_med / steps, 5),
+        "fused_speedup": round(g_med / f_med, 3) if f_med else None,
+        "steady_noise_ms_per_step": round(noise, 5),
+        "rpcs_total_fused": rpcs,
+        "fused_within_noise": bool((f_med - g_med) / steps <= noise),
+    }
+
+
+def _boundary_arm(n: int, window: int, batch: int, reps: int) -> dict:
+    """Epoch-boundary gap (time to the NEXT epoch's first batch after
+    draining the previous one) with the loader's boundary prefetch on
+    vs off — the worker hides the regen behind the previous epoch."""
+    from partiallyshuffledistributedsampler_tpu.sampler.host_loader import (
+        HostDataLoader,
+    )
+
+    data = np.arange(n, dtype=np.int64)
+
+    def gap_ms(prefetch: bool) -> float:
+        loader = HostDataLoader(
+            data, window=window, batch=batch, seed=0, rank=0, world=1,
+            boundary_prefetch=prefetch,
+        )
+        for _ in loader.epoch(0):
+            pass
+        t0 = time.perf_counter()
+        it = loader.epoch(1)
+        next(it)
+        ms = (time.perf_counter() - t0) * 1e3
+        for _ in it:
+            pass
+        return ms
+
+    off_ms = [gap_ms(False) for _ in range(reps)]
+    on_ms = [gap_ms(True) for _ in range(reps)]
+    off_med, on_med = float(np.median(off_ms)), float(np.median(on_ms))
+    noise = max(max(off_ms) - min(off_ms), _NOISE_FLOOR_BOUNDARY_MS)
+    return {
+        "boundary_gap_serial_ms": round(off_med, 3),
+        "boundary_gap_prefetched_ms": round(on_med, 3),
+        "boundary_noise_ms": round(noise, 3),
+        "boundary_overlap_within_noise": bool(on_med - off_med <= noise),
+    }
+
+
+def summarize(*, n: int = 100_000, window: int = 512, batch: int = 64,
+              reps: int = 5) -> dict:
+    """The ``details["fused"]`` tier: pipelined-vs-guarded serve wall and
+    the boundary-prefetch gap."""
+    out: dict = {"n": n, "batch": batch, "reps": reps}
+    out["serve"] = _serve_arms(n, window, batch, reps)
+    out["boundary"] = _boundary_arm(n, window, batch, reps)
+    return out
+
+
+def main() -> None:
+    """The `make fused-smoke` gate: hard assertions, one JSON line."""
+    report = summarize()
+    assert report["serve"]["fused_within_noise"], (
+        "pipelined serve path slower than the guarded path beyond its "
+        f"noise floor: {report['serve']!r}")
+    assert report["boundary"]["boundary_overlap_within_noise"], (
+        "boundary prefetch failed to keep the epoch gap within the "
+        f"serial arm's noise: {report['boundary']!r}")
+    print(json.dumps({"fused_smoke": "ok", **report}))
+
+
+if __name__ == "__main__":
+    main()

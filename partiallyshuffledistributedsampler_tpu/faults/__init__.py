@@ -15,8 +15,10 @@ Vocabulary:
   thread), ``server.snapshot_write`` (the daemon's snapshot persist),
   ``server.reshard`` (an elastic barrier freezing / committing),
   ``client.leave`` (a client announcing its preemption drain),
-  ``loader.prefetch`` (one step of the gather thread), ``loader.regen``
-  (local epoch index generation).
+  ``client.pipeline`` (the pipelined client topping up its lookahead
+  window), ``loader.prefetch`` (one step of the gather thread),
+  ``loader.regen`` (local epoch index generation), ``loader.boundary``
+  (the epoch-boundary prefetch worker).
 * A **fault kind** is what happens when a rule fires (:data:`KINDS`):
   ``reset`` (connection reset), ``delay`` (sleep ``delay_s``),
   ``torn_frame`` (half a frame hits the wire, then reset), ``corrupt``

@@ -31,9 +31,11 @@ SITES = frozenset({
     "wal.fsync",             # the durability WAL syncing its segment
     "wal.rotate",            # segment rollover / checkpoint GC truncation
     "client.leave",          # a client announcing its preemption drain
+    "client.pipeline",       # the pipelined client topping up its window
     "tenant.admission",      # a HELLO admitting / creating a tenant
     "loader.prefetch",       # one step of HostDataLoader's gather thread
     "loader.regen",          # local epoch index generation
+    "loader.boundary",       # the epoch-boundary prefetch worker fetching
 })
 
 #: what a firing rule does (interpreted by runtime.perform / the sites)

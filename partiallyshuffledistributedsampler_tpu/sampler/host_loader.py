@@ -39,7 +39,10 @@ from typing import Iterator, Optional
 import numpy as np
 
 from .. import faults as F
+from ..analysis.lockorder import new_lock
 from ..ops import core, ensure_index_backend
+from ..telemetry import NULL_SPAN
+from ..telemetry import enabled as _tel_enabled
 from ..telemetry import span as _span
 from ..utils.watchdog import StallError
 
@@ -116,6 +119,18 @@ class HostDataLoader:
         a typed :class:`~..utils.watchdog.StallError` carrying the stuck
         thread's stack instead of blocking forever.  ``None`` disables
         the watchdog.
+    boundary_prefetch: overlap the NEXT epoch's index regen (or service
+        fetch) with serving the current epoch's tail: ``epoch(e)`` kicks
+        a background worker that materializes epoch ``e+1``'s index
+        stream, and the next ``epoch()`` call adopts it instead of
+        paying the regen/fetch latency at the boundary — the epoch gap
+        drops to the validation cost.  The worker's result is advisory:
+        it is discarded (and the boundary recomputed in the foreground)
+        when it errored, when it is for a different epoch, or — on the
+        service path — when a reshard re-partitioned the world since the
+        fetch (a cheap ``heartbeat`` generation probe decides).  Costs
+        one extra epoch index array held across the boundary; False
+        restores strictly-serial boundaries.
 
     The sampler kwargs (shuffle/drop_last/order_windows/partition/rounds)
     pass through to the index core unchanged.
@@ -142,6 +157,7 @@ class HostDataLoader:
         degraded_fallback=True,
         reattach_interval: float = 5.0,
         stall_timeout: Optional[float] = 30.0,
+        boundary_prefetch: bool = True,
         **kwargs,
     ) -> None:
         if mixture is not None and shard_sizes is not None:
@@ -266,6 +282,10 @@ class HostDataLoader:
         #: True while serving locally because the index daemon is down
         self.degraded = False
         self._last_probe = float("-inf")
+        self.boundary_prefetch = bool(boundary_prefetch)
+        self._boundary_lock = new_lock("loader.boundary")
+        self._boundary_thread: Optional[threading.Thread] = None
+        self._boundary_box = None  # (epoch, generation, idx, exc)
         # ONE description of this loader's stream, shared verbatim with the
         # index service (service/spec.py) — local regen and a daemon serving
         # the same config cannot drift because both evaluate this object
@@ -374,8 +394,10 @@ class HostDataLoader:
         cached = getattr(self, "_idx_cache", None)
         if cached is not None and cached[0] == key:
             return cached[1]
-        idx = self._compute_epoch_indices(epoch, layers)
-        idx.setflags(write=False)  # shared between epoch_steps and epoch
+        idx = self._take_boundary(int(epoch)) if layers is None else None
+        if idx is None:
+            idx = self._compute_epoch_indices(epoch, layers)
+            idx.setflags(write=False)  # shared between epoch_steps and epoch
         self._idx_cache = (key, idx)
         return idx
 
@@ -385,6 +407,91 @@ class HostDataLoader:
         hundreds of MB for shard-mode epochs) array reclaimed before the
         next ``epoch()`` call.  Exhausting an epoch clears it too."""
         self._idx_cache = None
+        with self._boundary_lock:
+            self._boundary_box = None
+
+    # ------------------------------------------------- boundary prefetch
+    def _kick_boundary(self, next_epoch: int) -> None:
+        """Start materializing ``next_epoch``'s index stream in the
+        background so the next ``epoch()`` call finds it ready.
+
+        Under an armed fault plan the worker is suppressed: its regen /
+        wire ops would interleave with the foreground's and perturb the
+        plan's deterministic per-site hit counters.  The ``loader.boundary``
+        site still draws — in the caller's thread, so chaos runs stay
+        replayable — and any firing fault simply loses the prefetch (the
+        boundary falls back to foreground regen, stream unchanged)."""
+        if not self.boundary_prefetch:
+            return
+        if F.active() is not None:
+            try:
+                F.fire("loader.boundary")
+            except F.InjectedThreadDeath:
+                pass  # the worker "died": the prefetch is simply lost
+            except F.InjectedFault:
+                pass  # advisory path: a typed fault only loses the overlap
+            return
+        with self._boundary_lock:
+            box = self._boundary_box
+        t = self._boundary_thread
+        if (box is not None and box[0] == next_epoch) or (
+                t is not None and t.is_alive()):
+            return  # already prefetched (or in flight)
+
+        def _work() -> None:
+            F.fire("loader.boundary")
+            try:
+                idx = self._compute_epoch_indices(next_epoch, None)
+                idx.setflags(write=False)
+                gen = getattr(self.index_client, "generation", None)
+                box = (next_epoch, gen, idx, None)
+            except Exception as exc:  # lint: allow-broad-except(prefetch is advisory; the boundary recomputes in the foreground)
+                box = (next_epoch, None, None, exc)
+            with self._boundary_lock:
+                self._boundary_box = box
+
+        t = threading.Thread(target=_work, daemon=True,
+                             name="psds-boundary-prefetch")
+        self._boundary_thread = t
+        t.start()
+
+    def _take_boundary(self, epoch: int) -> Optional[np.ndarray]:
+        """Adopt the boundary worker's result for ``epoch``, or None when
+        it must be recomputed (wrong epoch, worker error, or — on the
+        service path — the membership generation moved since the fetch,
+        which re-partitions the epoch)."""
+        t = self._boundary_thread
+        if t is not None:
+            t.join(self.stall_timeout)
+            if t.is_alive():
+                if self.index_client is not None:
+                    # the client is not a concurrent-use surface: a
+                    # foreground fetch alongside the wedged worker would
+                    # interleave on one socket
+                    raise StallError(
+                        "boundary prefetch made no progress past "
+                        f"stall_timeout={self.stall_timeout}",
+                        thread=t,
+                    )
+                return None  # pure local regen: recompute alongside it
+            self._boundary_thread = None
+        with self._boundary_lock:
+            box, self._boundary_box = self._boundary_box, None
+        if F.active() is not None:
+            # an armed plan targets the FOREGROUND path's deterministic
+            # draw sequence; adopting a pre-plan prefetch would skip it
+            return None
+        if box is None or box[0] != epoch or box[2] is None:
+            return None
+        _, gen, idx, _ = box
+        if self.index_client is not None:
+            try:
+                fresh = self.index_client.heartbeat()
+            except Exception:  # lint: allow-broad-except(freshness probe only; the foreground fetch surfaces real errors)
+                return None
+            if fresh != gen or self.index_client.generation != gen:
+                return None  # resharded since the fetch: stale partition
+        return idx
 
     def _compute_epoch_indices(self, epoch: int, layers) -> np.ndarray:
         if self.index_client is not None:
@@ -416,41 +523,48 @@ class HostDataLoader:
         by the fingerprint handshake — and keep training; while degraded,
         probe the daemon at most every ``reattach_interval`` seconds and
         re-attach when it answers."""
+        if _tel_enabled():
+            with _span("loader.serve_epoch", epoch=int(epoch),
+                       rank=self.rank) as sp:
+                return self._served_indices_impl(epoch, sp)
+        # tracing off: skip the span machinery entirely — no kwargs
+        # dict, no int coercion, nothing allocated on the serve path
+        return self._served_indices_impl(epoch, NULL_SPAN)
+
+    def _served_indices_impl(self, epoch: int, sp) -> np.ndarray:
         from ..service.client import FencedError, ServiceUnavailable
 
         client = self.index_client
-        with _span("loader.serve_epoch", epoch=int(epoch),
-                   rank=self.rank) as sp:
-            if self.degraded:
-                now = time.monotonic()
-                if now - self._last_probe < self.reattach_interval:
-                    return self._local_indices(epoch)
-                self._last_probe = now
-                if not client.probe():
-                    return self._local_indices(epoch)
-                self.degraded = False
-                client.metrics.inc("reattached", self.rank)
-                sp.event("reattached")
-            try:
-                return np.asarray(client.epoch_indices(epoch))
-            except (ServiceUnavailable, FencedError) as exc:
-                # FencedError means every reachable peer lost a promotion
-                # race and no serving primary is attached — operationally
-                # the same "both peers down" as ServiceUnavailable
-                if not self.degraded_fallback:
-                    raise
-                warnings.warn(
-                    f"index service unavailable ({exc}); serving epoch "
-                    f"{epoch} from the local spec (bit-identical stream) "
-                    "and probing for re-attach",
-                    RuntimeWarning,
-                    stacklevel=3,
-                )
-                sp.event("degraded_fallback", error=str(exc))
-                client.metrics.inc("degraded_mode", self.rank)
-                self.degraded = True
-                self._last_probe = time.monotonic()
-                return self._local_indices(epoch, after=exc)
+        if self.degraded:
+            now = time.monotonic()
+            if now - self._last_probe < self.reattach_interval:
+                return self._local_indices(epoch)
+            self._last_probe = now
+            if not client.probe():
+                return self._local_indices(epoch)
+            self.degraded = False
+            client.metrics.inc("reattached", self.rank)
+            sp.event("reattached")
+        try:
+            return np.asarray(client.epoch_indices(epoch))
+        except (ServiceUnavailable, FencedError) as exc:
+            # FencedError means every reachable peer lost a promotion
+            # race and no serving primary is attached — operationally
+            # the same "both peers down" as ServiceUnavailable
+            if not self.degraded_fallback:
+                raise
+            warnings.warn(
+                f"index service unavailable ({exc}); serving epoch "
+                f"{epoch} from the local spec (bit-identical stream) "
+                "and probing for re-attach",
+                RuntimeWarning,
+                stacklevel=3,
+            )
+            sp.event("degraded_fallback", error=str(exc))
+            client.metrics.inc("degraded_mode", self.rank)
+            self.degraded = True
+            self._last_probe = time.monotonic()
+            return self._local_indices(epoch, after=exc)
 
     def _local_indices(self, epoch: int, *, after=None) -> np.ndarray:
         """Degraded-mode regen: evaluate the loader's own spec.  Safe to
@@ -570,6 +684,10 @@ class HostDataLoader:
             raise ValueError(
                 f"start_step {start_step} outside [0, {steps}]"
             )
+        # overlap the NEXT boundary with this epoch's serving (epochs
+        # after an elastic remainder are ordinary full epochs, so the
+        # prefetch target never carries layers)
+        self._kick_boundary(int(epoch) + 1)
         return self._epoch_gen(idx, steps, start_step)
 
     def _epoch_gen(self, idx: np.ndarray, steps: int,

@@ -44,7 +44,11 @@ class DeviceEpochIterator:
     ``epoch()`` costs one slice-and-unstack dispatch per ``_SPLIT_CHUNK``
     (512) steps — NOT one per step: a single compiled program slices a
     chunk of the epoch tensor and returns every step's batch as its own
-    device buffer, so the per-step cost is a Python yield.  Loops whose
+    device buffer, so the per-step cost is a Python yield.  The chunk
+    programs are double-buffered — chunk c+1 (and, across the boundary,
+    the next epoch's first chunk) is dispatched while chunk c's buffers
+    are being consumed — so neither the chunk seam nor the epoch
+    boundary waits on a dispatch.  Loops whose
     body is jittable should still prefer :meth:`run_epoch` (whole epoch,
     one dispatch) or :meth:`run_epochs` (whole run, one dispatch, regen
     in-program) — same values, zero dispatches between steps; the
@@ -89,6 +93,9 @@ class DeviceEpochIterator:
             )
         self.prefetch_next_epoch = prefetch_next_epoch
         self._cache: dict[int, jax.Array] = {}
+        #: epoch -> (idx array, first chunk's pre-dispatched unstack
+        #: buffers): the boundary half of the double-buffered ring
+        self._ring: dict[int, tuple] = {}
         self._runners: dict = {}
 
     def _regen(self, epoch: int) -> jax.Array:
@@ -110,6 +117,25 @@ class DeviceEpochIterator:
             for k in sorted(self._cache)[:-2]:
                 del self._cache[k]
 
+    def _ring_dispatch(self, epoch: int) -> None:
+        """Pre-dispatch ``epoch``'s FIRST chunk unstack behind the current
+        epoch's steps: the next ``epoch()`` call finds its opening batches
+        already split into per-step buffers, so the boundary dispatch
+        overlaps the previous epoch's tail instead of gapping it.  Only
+        the chunked serve path pays (and benefits): ``run_epoch`` scans
+        in-program and never consults the ring."""
+        arr = self._cache.get(epoch)
+        if arr is None:
+            return
+        whole = int(arr.shape[0]) // self.batch
+        if whole:
+            c = min(self._SPLIT_CHUNK, whole)
+            split = self._cached_runner(
+                ("split", c), lambda c=c: self._build_split(c)
+            )
+            self._ring.clear()  # at most one boundary in flight
+            self._ring[epoch] = (arr, split(arr, 0))
+
     def _build_split(self, chunk: int):
         """One program: slice ``chunk`` whole batches starting at a traced
         offset and unstack them — every step's batch comes back as its own
@@ -123,29 +149,57 @@ class DeviceEpochIterator:
 
         return split
 
-    def _serve_chunked(self, idx: jax.Array) -> Iterator[jax.Array]:
+    def _serve_chunked(self, idx: jax.Array, *,
+                       ring: Optional[tuple] = None) -> Iterator[jax.Array]:
         """Serve an index tensor as per-step batches: whole batches via the
         chunked one-dispatch unstack programs, then (drop_last_batch=False)
         the trailing partial batch.  epoch() and elastic_epoch() both route
-        here — the serve law lives once."""
+        here — the serve law lives once.
+
+        The chunk programs run DOUBLE-BUFFERED: chunk c+1's unstack is
+        dispatched before chunk c's buffers are yielded, so the device
+        splits the next chunk while the consumer steps through this one;
+        ``ring`` additionally adopts the epoch's first chunk when
+        ``_ring_dispatch`` pre-split it behind the previous epoch."""
         ns = int(idx.shape[0])
         whole = ns // self.batch
         s = 0
+        ahead = None  # (start_step, bufs) dispatched one chunk ahead
+        if ring is not None and ring[0] is idx:
+            # the identity check pins correctness: the pre-split buffers
+            # are adopted only for the exact array they were cut from
+            ahead = (0, ring[1])
         while s < whole:
             c = min(self._SPLIT_CHUNK, whole - s)
-            split = self._cached_runner(
-                ("split", c), lambda c=c: self._build_split(c)
-            )
-            yield from split(idx, s * self.batch)
-            s += c
+            if ahead is not None and ahead[0] == s and len(ahead[1]) == c:
+                bufs = ahead[1]
+            else:
+                split = self._cached_runner(
+                    ("split", c), lambda c=c: self._build_split(c)
+                )
+                bufs = split(idx, s * self.batch)
+            nxt = s + c
+            ahead = None
+            if nxt < whole:
+                c2 = min(self._SPLIT_CHUNK, whole - nxt)
+                split2 = self._cached_runner(
+                    ("split", c2), lambda c=c2: self._build_split(c2)
+                )
+                ahead = (nxt, split2(idx, nxt * self.batch))
+            yield from bufs
+            s = nxt
         if ns > whole * self.batch and not self.drop_last_batch:
             yield idx[whole * self.batch:]
 
     def epoch(self, epoch: int) -> Iterator[jax.Array]:
         idx = self.epoch_array(epoch)
+        # adopt this epoch's pre-split first chunk BEFORE dispatching the
+        # next boundary (the ring holds at most one epoch)
+        ring = self._ring.pop(int(epoch), None)
         if self.prefetch_next_epoch:
             self._prefetch(epoch)
-        yield from self._serve_chunked(idx)
+            self._ring_dispatch(int(epoch) + 1)
+        yield from self._serve_chunked(idx, ring=ring)
 
     def elastic_epoch_array(self, epoch: int, layers) -> jax.Array:
         """This rank's remainder-epoch indices after a world-size change

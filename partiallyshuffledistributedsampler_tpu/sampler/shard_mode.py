@@ -479,9 +479,11 @@ def expand_shard_indices_jax(
     device runs the identical uint32 program in device-rate time, with
     the output resident in HBM.  Grouping by size class stays on the
     host (shard sizes are metadata); one jitted program per class size,
-    reused across seeds and epochs (both traced).  Uniform sizes ship
-    only shard ids + offsets; mixed sizes additionally ship one
-    stream-order permutation per call and pay one device gather.
+    reused across seeds and epochs (both traced).  Host→device traffic
+    is O(shards) in every mode — uniform sizes ship only shard ids +
+    offsets, and mixed sizes scatter each class into one donated
+    output accumulator at O(rows) stream starts (never an O(total)
+    permutation ship).
     Datasets with MANY distinct shard sizes (a variable-length document
     corpus) do not compile one program per size: beyond
     ``_MAX_CLASS_PROGRAMS`` distinct sizes, shards bucket into
@@ -535,24 +537,23 @@ def expand_shard_indices_jax(
     if len(groups) == 1 and groups[0][1].shape[0] == sids.size:
         # uniform sizes: one program, the reshape IS the stream order
         return run_class(*groups[0]).reshape(-1)
-    # mixed sizes: concatenate per-class results on device, then ONE
-    # gather through a host-built stream-order permutation (a per-class
-    # scatter would copy the whole output buffer once per class)
-    parts = [run_class(m, members).reshape(-1) for m, members in groups]
-    cat = jnp.concatenate(parts) if parts else jnp.empty(0, dtype=dtype)
-    # zero-size shards occupy no output width, so the nonzero groups tile
-    # [0, total) exactly and the permutation below is total
-    perm = np.empty(total, dtype=off_dtype)
-    base = 0
+    # mixed sizes: each class's [k, m] block scatters straight into ONE
+    # donated, exactly-``total``-long accumulator at per-row stream
+    # starts — zero-size shards occupy no output width, so the nonzero
+    # classes' target rows tile [0, total) disjointly and the in-place
+    # scatters compose with no cross-class adds.  The previous cut
+    # concatenated the class results and gathered them through a
+    # host-built stream-order permutation: an O(total) host build, an
+    # O(total) host→device ship, and a full extra device copy per
+    # epoch, all replaced by O(rows) start positions (the same donation
+    # law as the bucketed path below).
+    acc = jnp.zeros((total,), dtype)
     for m, members in groups:
-        k = len(members)
-        ar = np.arange(m, dtype=np.int64)
-        stream_pos = (out_starts[members][:, None] + ar).ravel()
-        cat_pos = (base + np.arange(k, dtype=np.int64)[:, None] * m
-                   + ar).ravel()
-        perm[stream_pos] = cat_pos
-        base += k * m
-    return cat[jnp.asarray(perm)]
+        scat = _bucket_scatter_jit(total, m, big)
+        acc = scat(acc, run_class(m, members),
+                   np.full(len(members), m, np.uint32),
+                   out_starts[members].astype(off_dtype))
+    return acc
 
 
 #: per-program lane budget for the bucketed device expansion (element

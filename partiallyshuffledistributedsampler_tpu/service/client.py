@@ -59,12 +59,13 @@ from __future__ import annotations
 
 import socket
 import time
+from collections import deque
 from typing import Iterator, Optional
 
 import numpy as np
 
 from .. import faults as F
-from ..telemetry import span as _span
+from ..telemetry import enabled as _tel_enabled, span as _span
 from ..utils.retry import RetryPolicy
 from . import protocol as P
 from .metrics import ServiceMetrics
@@ -176,6 +177,12 @@ class ServiceIndexClient:
                  circuit breaker that makes a dead daemon fail fast
                  between operations instead of paying the full deadline
                  on every call.
+    lookahead:   how many GET_BATCH requests ``epoch_batches`` keeps in
+                 flight on a healthy connection (docs/SERVICE.md
+                 "Serve-path fusion").  The effective window is clamped
+                 by the server's WELCOME-advertised ``max_inflight`` so
+                 pipelining never trips the throttle gate; ``1``
+                 restores the strictly request-reply serve path.
     """
 
     def __init__(
@@ -191,6 +198,7 @@ class ServiceIndexClient:
         backoff_max: float = 2.0,
         metrics: Optional[ServiceMetrics] = None,
         retry_policy: Optional[RetryPolicy] = None,
+        lookahead: int = 4,
     ) -> None:
         self.address = _parse_address(address)
         self.rank = None if rank is None else int(rank)
@@ -213,6 +221,21 @@ class ServiceIndexClient:
                 breaker_threshold=12, breaker_reset=1.0,
             )
         self.metrics = metrics if metrics is not None else ServiceMetrics()
+        self.lookahead = int(lookahead)
+        if self.lookahead < 1:
+            raise ValueError(f"lookahead must be >= 1, got {lookahead}")
+        #: the server's throttle window, adopted from WELCOME (additive
+        #: field); bounds the pipelined lookahead so a full window of
+        #: un-acked requests is never refused as out-of-window
+        self._server_max_inflight: Optional[int] = None
+        #: learned cap after a throttle refusal mid-pipeline (an old
+        #: server that does not advertise ``max_inflight``)
+        self._pipe_cap: Optional[int] = None
+        #: a deferred delivered-ack cursor ``[epoch, ack]`` — the
+        #: previous epoch's terminal ack, piggybacked (header field
+        #: ``hb``) on the next GET_BATCH/HEARTBEAT instead of costing a
+        #: dedicated EOF poll; re-application is idempotent server-side
+        self._pending_hb: Optional[list] = None
         #: namespace id adopted from WELCOME (docs/SERVICE.md "Tenancy");
         #: stamped on every request so a re-dial of a multi-tenant daemon
         #: lands back in the same tenant even before the re-HELLO binds us
@@ -303,6 +326,9 @@ class ServiceIndexClient:
         t = header.get("term")
         if t is not None:
             self.term = max(self.term, int(t))
+        mi = header.get("max_inflight")
+        if mi is not None:
+            self._server_max_inflight = max(1, int(mi))
         self._adopt_membership(header)
         self._sock = sock
         self._promote_on_connect = False
@@ -404,6 +430,15 @@ class ServiceIndexClient:
         (docs/OBSERVABILITY.md).  The ``rpc_ms`` histogram observes the
         operation wall time whether or not tracing is on."""
         t0 = time.perf_counter()
+        if not _tel_enabled():
+            # tracing off: skip span construction entirely — no kwargs
+            # dict, no msg-name lookup, no thread-local push on the
+            # per-request hot path
+            try:
+                return self._rpc_attempts(msg_type, header)
+            finally:
+                self.metrics.registry.histogram("rpc_ms").observe(
+                    (time.perf_counter() - t0) * 1e3)
         with _span("client.rpc", msg=P.msg_name(msg_type),
                    rank=self.rank) as sp:
             ctx = sp.ids
@@ -613,13 +648,173 @@ class ServiceIndexClient:
         return self._begin_failover(peer, tried)
 
     # ------------------------------------------------------------- batches
+    def _pipe_limit(self) -> int:
+        """The effective lookahead window: the ``lookahead`` knob,
+        clamped by the server's WELCOME-advertised ``max_inflight`` and
+        by any cap learned from a throttle refusal mid-pipeline."""
+        lim = self.lookahead
+        if self._server_max_inflight is not None:
+            lim = min(lim, self._server_max_inflight)
+        if self._pipe_cap is not None:
+            lim = min(lim, self._pipe_cap)
+        return max(1, lim)
+
+    def _pipe_header(self, epoch: int, seqno: int, ack: int,
+                     gen: int) -> dict:
+        h = {"rank": self.rank, "epoch": epoch, "seq": seqno,
+             "ack": ack, "gen": gen}
+        if self.term > 0:
+            h["term"] = self.term
+        if self.tenant is not None:
+            h["tenant"] = self.tenant
+        return h
+
+    def _drain_replies(self, sock, n: int) -> None:
+        """Read and discard the replies to still-in-flight pipelined
+        requests — the server answers every request exactly once, in
+        order, so the count is known.  Discarded batches are *unacked*:
+        re-requesting them through the guarded path is exactly-once by
+        construction (the cursor only advances on yield)."""
+        for _ in range(n):
+            P.recv_msg(sock)
+
+    def _pipelined_batches(self, epoch: int, seq: int, gen: int,
+                           rejects: int):
+        """The fused steady-state serve path: keep up to
+        ``_pipe_limit()`` GET_BATCH requests in flight, topping the
+        window up with ONE coalesced send per delivered batch
+        (``P.send_msgs``) so the next reply is already in the socket
+        buffer while the consumer holds the current batch.
+
+        Exactly-once survives any failure here because the cursor
+        advances only when a batch is yielded: every in-flight request
+        past the cursor is unacked, so tearing the connection (or
+        discarding queued replies after a typed error) merely re-requests
+        those seqs through the guarded `_rpc` path.
+
+        Returns ``(done, seq, rejects)``; ``done`` means the epoch
+        stream completed.  Any error/typed refusal returns ``done=False``
+        and lets ``epoch_batches`` recover through the guarded path.
+        The terminal EOF poll is ALWAYS left to the guarded path: its
+        ack (the epoch's last delivered batch) gates elastic drain
+        barriers, so it must ride `_rpc`'s reshard-wait machinery, not a
+        fire-and-forget pipeline slot."""
+        sock = self._sock
+        w = self._pipe_limit()
+        hist = self.metrics.registry.histogram("step_serve_ms")
+        pending = deque()        # requested-but-unconsumed seqs, in order
+        next_req = seq
+        bound = None             # request-seq bound once total is known
+        hb_seq = None            # seq of the request carrying _pending_hb
+        ramp = 1                 # slow-start: the window grows one per
+        #                          delivered batch, so a cold epoch is
+        #                          never one indivisible burst (and the
+        #                          stream total is learned before more
+        #                          than one request is committed)
+        try:
+            while True:
+                msgs = []
+                while len(pending) < min(w, ramp) and (bound is None
+                                                       or next_req < bound):
+                    h = self._pipe_header(epoch, next_req, seq - 1, gen)
+                    if self._pending_hb is not None and hb_seq is None:
+                        h["hb"] = list(self._pending_hb)
+                        hb_seq = next_req
+                    msgs.append((P.MSG_GET_BATCH, h))
+                    pending.append(next_req)
+                    next_req += 1
+                if msgs:
+                    F.fire("client.pipeline")
+                    self.metrics.inc("rpcs_per_step", self.rank,
+                                     value=len(msgs))
+                    P.send_msgs(sock, msgs, site="service.send")
+                if not pending:
+                    # every real batch is delivered: hand the terminal
+                    # EOF poll (and its drain-gating ack) to the guarded
+                    # path
+                    return False, seq, rejects
+                t0 = time.perf_counter()
+                reply, rheader, payload = P.recv_msg(sock,
+                                                     site="service.recv")
+                expect = pending.popleft()
+                if reply == P.MSG_ERROR:
+                    code = rheader.get("code", "error")
+                    if code == "throttle":
+                        # server window smaller than ours (a peer that
+                        # predates the WELCOME advertisement): shrink
+                        # and let the guarded path resume
+                        self.metrics.inc("throttled", self.rank)
+                        self._pipe_cap = max(1, (len(pending) + 1) // 2)
+                    self._drain_replies(sock, len(pending))
+                    return False, seq, rejects
+                if reply != P.MSG_BATCH or int(rheader.get("seq",
+                                                           -1)) != expect:
+                    raise P.ProtocolError(
+                        f"pipelined reply out of order: expected BATCH "
+                        f"seq {expect}, got {P.msg_name(reply)} seq "
+                        f"{rheader.get('seq')}")
+                if expect == hb_seq:
+                    # the piggybacked previous-epoch ack landed
+                    self._pending_hb = None
+                    hb_seq = None
+                if rheader.get("end") is not None:
+                    self._epoch_samples = max(self._epoch_samples,
+                                              int(rheader["end"]))
+                if rheader.get("eof"):
+                    # only an entry-point request (resume at the epoch
+                    # tail) can draw an EOF here — its own ack was the
+                    # terminal one, so the stream is complete
+                    self._drain_replies(sock, len(pending))
+                    return True, seq, rejects
+                try:
+                    arr = P.decode_indices(rheader, payload)
+                except P.ChecksumError:
+                    rejects += 1
+                    self.metrics.inc("checksum_rejects", self.rank)
+                    if rejects > _MAX_CHECKSUM_REJECTS:
+                        raise
+                    # unacked: the guarded path re-requests this seq and
+                    # everything queued behind it
+                    self._drain_replies(sock, len(pending))
+                    return False, seq, rejects
+                rejects = 0
+                ramp = min(w, ramp + 1)
+                if bound is None and rheader.get("total") is not None:
+                    # cap requests at the last REAL batch; the EOF poll
+                    # stays on the guarded path (see docstring)
+                    bound = -(-int(rheader["total"]) // self.batch)
+                self.metrics.inc("batches_served", self.rank)
+                seq += 1
+                self._cursor = {"epoch": epoch, "seq": seq}
+                hist.observe((time.perf_counter() - t0) * 1e3)
+                yield arr
+        except P.ChecksumError:
+            raise
+        except (ConnectionError, socket.timeout, OSError,
+                P.ProtocolError):
+            # the connection (and every queued reply) is gone; all of it
+            # was unacked, so the guarded path replays it exactly-once
+            self.close()
+            self.metrics.inc("reconnects", self.rank)
+            return False, seq, rejects
+
     def epoch_batches(self, epoch: int, *,
                       start_seq: int = 0) -> Iterator[np.ndarray]:
         """Stream the rank's batches for ``epoch`` from ``start_seq`` on.
 
-        Each ``GET_BATCH`` acks everything before it (the batches this
-        generator already yielded), keeping the in-flight window at one —
-        comfortably inside any server's ``max_inflight``.
+        On a healthy connection the stream is *pipelined*: up to
+        ``lookahead`` GET_BATCH requests ride in flight (clamped by the
+        server's ``max_inflight``), topped up with one coalesced send
+        per delivered batch, so the per-step cost is one socket read of
+        an already-buffered reply.  Each request still acks everything
+        this generator already yielded — the in-flight window is exactly
+        the unacked span the server's throttle gate admits — and the
+        previous epoch's terminal ack piggybacks on the next epoch's
+        first request (header field ``hb``) instead of a dedicated EOF
+        poll.  Any fault or typed refusal drops to the guarded
+        request-reply path below, which re-requests from the cursor:
+        delivery stays exactly-once because the cursor advances only on
+        yield, never on receipt.
 
         Rides through reshards: a ``resharded`` reply (or reconnect) makes
         the generator adopt the new membership, renegotiate a rank if its
@@ -639,6 +834,7 @@ class ServiceIndexClient:
         rejects = 0
         gen = self.generation
         behind_t0 = None
+        hist = self.metrics.registry.histogram("step_serve_ms")
         while True:
             if self.generation != gen:
                 # a reconnect inside _rpc adopted a newer membership
@@ -646,11 +842,26 @@ class ServiceIndexClient:
                 # head of the post-reshard remainder
                 gen, seq = self.generation, 0
                 self._cursor = {"epoch": epoch, "seq": seq}
+            if (self._sock is not None and not self._leaving
+                    and self._pipe_limit() > 1 and not _tel_enabled()):
+                # fused fast path (tracing keeps the one-span-per-RPC
+                # guarded path for attribution; a leaving rank must see
+                # its terminal drain eof, served by the guarded path)
+                done, seq, rejects = yield from self._pipelined_batches(
+                    epoch, seq, gen, rejects)
+                if done:
+                    return
+                if self.generation != gen:
+                    continue
+            # guarded request-reply path: recovery, lookahead=1, tracing
+            t_req = time.perf_counter()
+            req = {"rank": self.rank, "epoch": epoch, "seq": seq,
+                   "ack": seq - 1, "gen": gen}
+            if self._pending_hb is not None:
+                req["hb"] = list(self._pending_hb)
+            self.metrics.inc("rpcs_per_step", self.rank)
             try:
-                reply, header, payload = self._rpc(P.MSG_GET_BATCH, {
-                    "rank": self.rank, "epoch": epoch, "seq": seq,
-                    "ack": seq - 1, "gen": gen,
-                })
+                reply, header, payload = self._rpc(P.MSG_GET_BATCH, req)
             except ServiceError as exc:
                 if exc.code == "resharded":
                     if self._leaving:
@@ -678,7 +889,7 @@ class ServiceIndexClient:
                                 f"peer at {self.address} stayed a "
                                 "generation behind past the reconnect "
                                 "deadline") from None
-                        self._flush_trail_ack(epoch)
+                        self._queue_trail_ack(epoch)
                         time.sleep(min(0.05, self.backoff_base))
                         continue
                     behind_t0 = None
@@ -711,6 +922,9 @@ class ServiceIndexClient:
                 raise P.ProtocolError(
                     f"expected BATCH, got {P.msg_name(reply)}"
                 )
+            if "hb" in req:
+                # the piggybacked previous-epoch ack landed server-side
+                self._pending_hb = None
             if header.get("eof"):
                 # a terminal drain eof additionally carries left=True; in
                 # both cases the stream for this rank is complete
@@ -742,6 +956,7 @@ class ServiceIndexClient:
                 # stream — what the trail records at the next adoption
                 self._epoch_samples = max(self._epoch_samples,
                                           int(header["end"]))
+            hist.observe((time.perf_counter() - t_req) * 1e3)
             yield arr
 
     def epoch_indices(self, epoch: int) -> np.ndarray:
@@ -759,32 +974,38 @@ class ServiceIndexClient:
         self.server_epoch = int(header["epoch"])
         return self.server_epoch
 
-    def heartbeat(self) -> None:
+    def heartbeat(self) -> int:
         """Keepalive; also carries the delivered-ack cursor, so an idle
         client still completes an elastic drain — the barrier commits on
-        *acked* delivery, not on served bytes."""
+        *acked* delivery, not on served bytes.  A queued ``hb`` ack (a
+        trail-ack a behind peer still needs) piggybacks here too.
+        Returns the server's current generation — the cheap
+        membership-freshness probe the loader's boundary prefetch uses."""
         header = {"rank": self.rank}
         if self._cursor["epoch"] is not None:
             header["epoch"] = int(self._cursor["epoch"])
             header["ack"] = int(self._cursor["seq"]) - 1
-        self._rpc(P.MSG_HEARTBEAT, header)
+        if self._pending_hb is not None:
+            header["hb"] = list(self._pending_hb)
+        _, rheader, _ = self._rpc(P.MSG_HEARTBEAT, header)
+        if "hb" in header:
+            self._pending_hb = None
+        return int(rheader.get("generation", self.generation))
 
-    def _flush_trail_ack(self, epoch: int) -> None:
-        """Re-deliver the pre-barrier ack watermark (the trail's last
-        recorded delivery) to a generation-behind peer, so its inherited
-        drain gate — which commits on *acked* delivery — can complete
-        the barrier the dead primary never shipped the commit of."""
+    def _queue_trail_ack(self, epoch: int) -> None:
+        """Queue the pre-barrier ack watermark (the trail's last recorded
+        delivery) as a piggybacked ``hb`` on the next request, so a
+        generation-behind peer's inherited drain gate — which commits on
+        *acked* delivery — can complete the barrier the dead primary
+        never shipped the commit of, without a dedicated heartbeat RPC
+        (the server applies ``hb`` before its generation check)."""
         if not self._trail:
             return
         samples = int(self._trail[-1].get("samples", 0))
         ack = -(-samples // self.batch) - 1  # ceil(samples/batch) - 1
         if ack < 0:
             return
-        try:
-            self._rpc(P.MSG_HEARTBEAT,
-                      {"rank": self.rank, "epoch": int(epoch), "ack": ack})
-        except ServiceError:
-            pass  # best-effort: the stream loop comes back around
+        self._pending_hb = [int(epoch), ack]
 
     def snapshot(self) -> dict:
         _, header, _ = self._rpc(P.MSG_SNAPSHOT, {})

@@ -178,6 +178,32 @@ def send_msg(sock: socket.socket, msg_type: int, header: dict,
     sock.sendall(frame)
 
 
+def send_msgs(sock: socket.socket, msgs, *, site: str = None) -> None:
+    """Frame several messages and send them as ONE coalesced buffer
+    (writev-style) — one syscall instead of one per request, which is
+    what lets the pipelined client top up its lookahead window without
+    multiplying per-step wire ops.
+
+    ``msgs`` is an iterable of ``(msg_type, header)`` or ``(msg_type,
+    header, payload)`` tuples, each packed exactly as :func:`send_msg`
+    packs it, so the receiver cannot tell coalesced frames from
+    individual sends.  A single fault draw applies to the *combined*
+    buffer: a ``torn_frame``/``reset`` rule tears mid-stream across
+    message boundaries — exactly the failure a pipelined sender must
+    survive with its acks intact.
+    """
+    parts = []
+    for m in msgs:
+        payload = m[2] if len(m) > 2 else b""
+        parts.append(pack(m[0], m[1], payload))
+    frame = b"".join(parts)
+    if site is not None:
+        rule = F.draw(site)
+        if rule is not None:
+            frame = F.apply_to_frame(rule, sock, frame)
+    sock.sendall(frame)
+
+
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
     buf = bytearray()
     while len(buf) < n:

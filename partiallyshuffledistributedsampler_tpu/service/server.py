@@ -1338,15 +1338,23 @@ class IndexServer:
                 t0 = time.perf_counter()
                 eng = (self._conn_tenant.get(conn_id, self)
                        if self.multi_tenant else self)
-                extra = {"tenant": eng.tenant_id} if self.multi_tenant \
-                    else {}
                 try:
-                    # the span wraps the fault-injection point too, so a
-                    # dump triggered by an injected dispatch fault shows
-                    # the request being served when it fired
-                    with _span("server." + P.msg_name(msg),
-                               trace=header.get("trace"), conn=conn_id,
-                               rank=header.get("rank"), **extra):
+                    if telemetry.enabled():
+                        extra = {"tenant": eng.tenant_id} \
+                            if self.multi_tenant else {}
+                        # the span wraps the fault-injection point too,
+                        # so a dump triggered by an injected dispatch
+                        # fault shows the request being served when it
+                        # fired
+                        with _span("server." + P.msg_name(msg),
+                                   trace=header.get("trace"), conn=conn_id,
+                                   rank=header.get("rank"), **extra):
+                            F.fire("server.dispatch")
+                            self._dispatch(sock, conn_id, msg, header,
+                                           payload)
+                    else:
+                        # tracing off: no span, no kwargs dict, no name
+                        # concat on the per-request hot path
                         F.fire("server.dispatch")
                         self._dispatch(sock, conn_id, msg, header, payload)
                 except OSError:
@@ -1479,6 +1487,58 @@ class IndexServer:
         self._write_snapshot(force=True)
         P.send_msg(sock, P.MSG_OK, {"epoch": self.epoch})
 
+    def _ack_advance_locked(self, rank: int, lease: dict, epoch, ack) -> bool:
+        """Advance ``rank``'s delivered-ack cursor for ``epoch`` and, if
+        that satisfies a drain barrier's ack gate, complete the rank's
+        drain.  Returns True when this ack committed the barrier.
+        Shared by HEARTBEAT and the ``hb`` field piggybacked on
+        GET_BATCH/HEARTBEAT; caller holds ``self._lock``."""
+        committed = False
+        cur = self._cursors.get(rank)
+        if cur is None or cur["epoch"] != int(epoch):
+            return False
+        cur["acked"] = max(cur["acked"], int(ack))
+        self._repl_append("cursor", rank=rank, **cur)
+        rs = self._reshard
+        if (rs is not None and rs.get("phase") == "drain"
+                and int(epoch) == rs["epoch"]
+                and rank in rs["targets"]
+                and rank not in rs["drained"]
+                and (cur["acked"] + 1) * int(lease.get("batch") or 0)
+                >= int(rs["targets"][rank])):
+            rs["drained"].add(rank)
+            try:
+                committed = self._commit_reshard_locked()
+            except F.InjectedThreadDeath:
+                raise
+            except Exception:  # lint: allow-broad-except(injected commit fault; retried)
+                pass
+            if not committed:
+                self._repl_append("state", state=self._state_dict_locked())
+        return committed
+
+    def _apply_piggyback_ack(self, conn_id, rank, hb) -> None:
+        """Apply a piggybacked ``hb: [epoch, ack]`` header field — a
+        delivered-ack cursor for an epoch OTHER than the one the
+        carrying request is about (typically the previous epoch's
+        terminal ack, deferred by the pipelined client instead of a
+        dedicated EOF poll).  Re-application is idempotent (the cursor
+        is a max), so a retried request may carry the same ``hb``."""
+        if hb is None or rank is None:
+            return
+        try:
+            hb_epoch, hb_ack = int(hb[0]), int(hb[1])
+        except (TypeError, ValueError, IndexError):
+            return  # malformed piggyback: ignore, the request stands alone
+        committed = False
+        with self._lock:
+            lease = self._leases.get(int(rank))
+            if lease is not None and lease.get("owner") == conn_id:
+                committed = self._ack_advance_locked(
+                    int(rank), lease, hb_epoch, hb_ack)
+        if committed:
+            self._write_snapshot(force=True)
+
     def _on_heartbeat(self, sock, conn_id, header) -> None:
         """Keepalive, optionally carrying the client's delivered-ack
         cursor (``epoch`` + ``ack``).  The ack matters during a drain:
@@ -1487,6 +1547,7 @@ class IndexServer:
         froze) would otherwise never deliver the final ack that
         completes its drain."""
         rank = header.get("rank")
+        self._apply_piggyback_ack(conn_id, rank, header.get("hb"))
         committed = False
         with self._lock:
             lease = self._leases.get(int(rank)) if rank is not None \
@@ -1496,29 +1557,8 @@ class IndexServer:
                 self._touch(rank, lease)
                 ack, epoch = header.get("ack"), header.get("epoch")
                 if ack is not None and epoch is not None:
-                    cur = self._cursors.get(rank)
-                    if cur is not None and cur["epoch"] == int(epoch):
-                        cur["acked"] = max(cur["acked"], int(ack))
-                        self._repl_append("cursor", rank=rank, **cur)
-                        rs = self._reshard
-                        if (rs is not None and rs.get("phase") == "drain"
-                                and int(epoch) == rs["epoch"]
-                                and rank in rs["targets"]
-                                and rank not in rs["drained"]
-                                and (cur["acked"] + 1)
-                                * int(lease.get("batch") or 0)
-                                >= int(rs["targets"][rank])):
-                            rs["drained"].add(rank)
-                            try:
-                                committed = self._commit_reshard_locked()
-                            except F.InjectedThreadDeath:
-                                raise
-                            except Exception:  # lint: allow-broad-except(injected commit fault; retried)
-                                pass
-                            if not committed:
-                                self._repl_append(
-                                    "state",
-                                    state=self._state_dict_locked())
+                    committed = self._ack_advance_locked(
+                        rank, lease, epoch, ack)
             gen = self.generation
         if committed:
             self._write_snapshot(force=True)
@@ -2058,6 +2098,9 @@ class IndexServer:
                 "term": int(front.term),
                 "standby": (list(front._standby_addr)
                             if front._standby_addr is not None else None),
+                # additive: the pipelined client bounds its lookahead by
+                # the server's throttle window (docs/SERVICE.md)
+                "max_inflight": int(self.max_inflight),
                 **self._membership_locked(),
             }
         self._write_snapshot()
@@ -2110,6 +2153,11 @@ class IndexServer:
             P.send_msg(sock, P.MSG_ERROR,
                        {"code": "bad_request", "detail": f"seq {seq} < 0"})
             return
+        # a piggybacked previous-epoch terminal ack lands BEFORE the
+        # request's own generation/epoch logic: if it completes a drain
+        # (bumping the generation), this very request is then refused
+        # with the fresh membership — exactly what its sender must adopt
+        self._apply_piggyback_ack(conn_id, rank, header.get("hb"))
         gen = int(header.get("gen", 0))
         with self._lock:
             if gen != self.generation:

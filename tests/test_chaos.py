@@ -237,6 +237,12 @@ _SERVICE_FAULTS = [
     ("service.recv", "corrupt", dict(nth=2, count=1)),
     ("server.dispatch", "thread_death", dict(nth=2, count=1)),
     ("server.snapshot_write", "disk_full", dict(nth=1, count=2)),
+    # the pipelined window's coalesced top-up send: a reset tears the
+    # connection with a full lookahead of unacked requests in flight,
+    # a delay stretches it — either way the guarded path must replay
+    # the window exactly-once
+    ("client.pipeline", "reset", dict(nth=1, count=1)),
+    ("client.pipeline", "delay", dict(nth=2, count=2, delay_s=0.01)),
 ]
 
 
@@ -282,6 +288,32 @@ def test_persistent_corruption_is_a_typed_error():
     assert time.monotonic() - t0 < 10.0
 
 
+@pytest.mark.parametrize("mode", sorted(SPECS))
+@pytest.mark.parametrize("kind", ["torn_frame", "reset"])
+def test_ack_carrying_request_torn_mid_flight_exactly_once(mode, kind):
+    """The coalesced GET_BATCH frames each carry the delivered-ack
+    cursor.  Tearing that send mid-flight (after epoch 1 delivered, so
+    the lost frames carry real ack state) must not double-serve or drop
+    anything: the cursor only advanced on yield, so the replay through
+    the guarded path keeps both epochs bit-identical."""
+    spec = SPECS[mode](world=1)
+    # nth high enough to land mid-stream of the second epoch's window
+    plan = F.FaultPlan([F.FaultRule(site="service.send", kind=kind,
+                                    nth=4, count=1)])
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        with plan:
+            with IndexServer(spec) as srv:
+                with ServiceIndexClient(srv.address, rank=0, batch=37,
+                                        lookahead=4, backoff_base=0.01,
+                                        reconnect_timeout=10.0) as client:
+                    got1 = client.epoch_indices(1)
+                    got2 = client.epoch_indices(2)
+    assert plan.fired("service.send") > 0, "fault never fired; vacuous"
+    assert np.array_equal(got1, np.asarray(spec.rank_indices(1, 0)))
+    assert np.array_equal(got2, np.asarray(spec.rank_indices(2, 0)))
+
+
 # --------------------------------------------------- loader-side fault matrix
 @pytest.mark.parametrize("mode", sorted(SPECS))
 def test_loader_prefetch_delay_stream_identical(mode):
@@ -319,6 +351,30 @@ def test_loader_regen_fault_is_typed(mode):
             loader.epoch_indices(0)
     assert plan.fired("loader.regen") == 1
     assert ei.value.site == "loader.regen"
+
+
+@pytest.mark.parametrize("mode", sorted(SPECS))
+@pytest.mark.parametrize("kind", ["thread_death", "error", "delay"])
+def test_loader_boundary_prefetch_fault_recomputes_foreground(mode, kind):
+    """The epoch-boundary prefetch worker is advisory: killing it,
+    failing it, or delaying it must leave every epoch's stream identical
+    — the boundary just recomputes in the foreground."""
+    ref_loader = make_loader(mode)
+    ref = [collect(ref_loader, e) for e in range(3)]
+    kw = dict(nth=1, count=2)
+    if kind == "delay":
+        kw["delay_s"] = 0.01
+    plan = F.FaultPlan([F.FaultRule(site="loader.boundary", kind=kind,
+                                    **kw)])
+    with plan:
+        loader = make_loader(mode)
+        got = [collect(loader, e) for e in range(3)]
+    assert plan.fired("loader.boundary") > 0, "fault never fired; vacuous"
+    for e in range(3):
+        assert len(got[e]) == len(ref[e])
+        for a, b in zip(got[e], ref[e]):
+            assert np.array_equal(a, b), (
+                f"boundary fault changed epoch {e} ({mode}/{kind})")
 
 
 def test_loader_stall_watchdog_on_wedged_producer():

@@ -214,3 +214,31 @@ def test_durability_doc_cross_linked():
 
     for site in ("wal.append", "wal.fsync", "wal.rotate"):
         assert site in F.SITES and site in res
+
+
+def test_fusion_doc_cross_linked():
+    """The serve-path fusion surface is documented where an operator
+    would look: SERVICE.md owns the pipelining/piggyback story (the
+    protocol fields, the guarded-terminal-ack rule, the make gate),
+    API.md documents the knobs, OBSERVABILITY.md the metric names, and
+    RESILIENCE.md the fault sites the chaos matrix drives."""
+    svc = (DOCS / "SERVICE.md").read_text()
+    assert "## Serve-path fusion" in svc, (
+        "docs/SERVICE.md lost its Serve-path fusion section")
+    for token in ("lookahead", "max_inflight", "hb", "coalesced",
+                  "fused-smoke", "slow start"):
+        assert token in svc, f"docs/SERVICE.md fusion lost `{token}`"
+    api = API_MD.read_text()
+    for token in ("lookahead=4", "boundary_prefetch=True",
+                  "Serve-path fusion"):
+        assert token in api, f"docs/API.md lost the fusion surface `{token}`"
+    obs = OBSERVABILITY_MD.read_text()
+    for token in ("step_serve_ms", "rpcs_per_step"):
+        assert token in obs, (
+            f"docs/OBSERVABILITY.md lost the fusion metric `{token}`")
+    # the documented fault sites must be the registered ones
+    from partiallyshuffledistributedsampler_tpu import faults as F
+
+    res = (DOCS / "RESILIENCE.md").read_text()
+    for site in ("client.pipeline", "loader.boundary"):
+        assert site in F.SITES and site in res

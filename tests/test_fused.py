@@ -1,0 +1,272 @@
+"""Serve-path fusion: the pipelined client is an optimisation, never a
+semantics change.
+
+The law (docs/SERVICE.md "Serve-path fusion"): with ``lookahead > 1``
+the client keeps a window of GET_BATCH requests in flight and the
+server's replies queue in the socket buffer — but the delivered stream
+must stay bit-identical to the guarded request-reply path through every
+hazard the guarded path survives, because the ack cursor advances only
+on yield and everything in flight past it is unacked.  Covered here:
+
+* multi-epoch pipelined streams bit-identical to ``spec.rank_indices``
+  in all three spec modes, with the coalesced multi-frame send observed
+  actually happening (the fast path engaged, not silently bypassed);
+* a mid-stream reshard freeze with pipelined clients: the
+  prefetched-but-unacked window is refused/discarded and replayed
+  through the guarded path — the union law holds exactly-once;
+* a primary hard-killed under pipelined clients: both ranks finish on
+  the promoted standby bit-identically, zero degraded entries;
+* the WELCOME ``max_inflight`` clamp on an over-eager ``lookahead``;
+* the loader's ``boundary_prefetch`` arm bit-matching the serial arm.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from partiallyshuffledistributedsampler_tpu.sampler.host_loader import (
+    HostDataLoader,
+)
+from partiallyshuffledistributedsampler_tpu.service import (
+    IndexServer,
+    ServiceIndexClient,
+)
+from partiallyshuffledistributedsampler_tpu.service import protocol as P
+
+from test_elastic_service import (
+    MAX_UNIT,
+    assert_union_law,
+    build_spec,
+    epoch_union_ref,
+)
+from test_failover import replicated_pair, wait_synced
+
+pytestmark = pytest.mark.fused
+
+
+class _SendSpy:
+    """Record the frame counts of every coalesced ``send_msgs`` call so a
+    test can prove the pipelined window actually opened (>1 frame in one
+    send), not just that the stream happened to be correct."""
+
+    def __init__(self, monkeypatch):
+        self.frame_counts = []
+        real = P.send_msgs
+
+        def spy(sock, msgs, **kw):
+            self.frame_counts.append(len(msgs))
+            return real(sock, msgs, **kw)
+
+        monkeypatch.setattr(P, "send_msgs", spy)
+
+    @property
+    def coalesced(self):
+        return max(self.frame_counts, default=0) > 1
+
+
+# --------------------------------------------------- steady-state streams
+@pytest.mark.parametrize("mode", ["plain", "mixture", "shard"])
+def test_pipelined_stream_bit_identical_across_epochs(mode, monkeypatch):
+    """Three consecutive epochs through one ``lookahead=4`` client are
+    bit-identical to the spec, and the multi-frame coalesced send is
+    observed (the fast path engaged across the epoch boundaries)."""
+    spy = _SendSpy(monkeypatch)
+    spec = build_spec(mode, 2)
+    with IndexServer(spec) as srv:
+        with ServiceIndexClient(srv.address, rank=0, batch=23,
+                                lookahead=4) as c:
+            for epoch in range(3):
+                got = np.concatenate(list(c.epoch_batches(epoch)))
+                ref = np.asarray(spec.rank_indices(epoch, 0))
+                assert np.array_equal(got, ref), (
+                    f"pipelined stream diverged at epoch {epoch} ({mode})")
+            counters = c.metrics.report()["counters"]
+    assert spy.coalesced, "the pipelined window never coalesced a send"
+    # one RPC per delivered batch plus one guarded terminal EOF poll per
+    # epoch — pipelining must not inflate the request count
+    steps = sum(-(-len(np.asarray(spec.rank_indices(e, 0))) // 23)
+                for e in range(3))
+    assert counters["batches_served"] == steps
+    assert counters["rpcs_per_step"] == steps + 3
+
+
+def test_lookahead_clamped_by_welcome_max_inflight():
+    """An over-eager ``lookahead`` is clamped to the server's WELCOME
+    ``max_inflight`` advertisement; the stream stays exact."""
+    spec = build_spec("plain", 1)
+    with IndexServer(spec) as srv:
+        with ServiceIndexClient(srv.address, rank=0, batch=64,
+                                lookahead=4096) as c:
+            got = np.concatenate(list(c.epoch_batches(0)))
+            assert c._server_max_inflight is not None
+            assert c._pipe_limit() <= c._server_max_inflight
+        assert np.array_equal(got, np.asarray(spec.rank_indices(0, 0)))
+
+
+# ------------------------------------------------------- reshard freeze
+@pytest.mark.parametrize("mode", ["plain", "mixture", "shard"])
+def test_reshard_freeze_replays_prefetched_unacked(mode):
+    """A reshard barrier freezes the epoch while every client holds a
+    pipelined window of prefetched-but-unacked batches.  Those replies
+    are discarded unacked and re-requested through the guarded path, so
+    the union of pre-barrier and post-barrier deliveries obeys the
+    exactly-once union law — nothing dropped, nothing double-served
+    beyond the wrap-pad allowance."""
+    old_world, new_world = 4, 3
+    spec = build_spec(mode, old_world)
+    ref = epoch_union_ref(spec)
+    delivered = {}
+    lock = threading.Lock()
+    b_hit = threading.Barrier(old_world)
+    b_go = threading.Barrier(old_world)
+    with IndexServer(spec) as srv:
+        addr = srv.address
+
+        def worker(r):
+            got = []
+            c = ServiceIndexClient(addr, rank=r, batch=23, lookahead=4,
+                                   backoff_base=0.01,
+                                   reconnect_timeout=20.0)
+            try:
+                it = c.epoch_batches(0)
+                for _ in range(1 + r):
+                    try:
+                        got.append(next(it))
+                    except StopIteration:
+                        break
+                b_hit.wait(timeout=30.0)
+                if r == 0:
+                    c.reshard(new_world)
+                b_go.wait(timeout=30.0)
+                for arr in it:
+                    got.append(arr)
+            finally:
+                with lock:
+                    delivered[r] = got
+                c.close()
+
+        threads = [threading.Thread(target=worker, args=(r,))
+                   for r in range(old_world)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60.0)
+            assert not t.is_alive(), "pipelined reshard worker hung"
+    union = np.concatenate(
+        [np.concatenate(v) if v else np.empty(0, np.int64)
+         for v in delivered.values()])
+    assert_union_law(union, ref, new_world=new_world,
+                     max_unit=MAX_UNIT[mode])
+
+
+# ------------------------------------------------------------- failover
+@pytest.mark.parametrize("mode", ["plain", "mixture", "shard"])
+def test_failover_pipelined_streams_bit_identical(mode):
+    """Primary hard-killed while both ranks hold pipelined windows: the
+    in-flight prefetched batches die with the connection (all unacked),
+    the clients replay them from the promoted standby, and the streams
+    are bit-identical to an unkilled run with zero degraded entries."""
+    spec = build_spec(mode, 2)
+    primary, standby = replicated_pair(spec)
+    delivered = {}
+    lock = threading.Lock()
+    b_streamed = threading.Barrier(3)
+    b_killed = threading.Barrier(3)
+
+    def worker(r):
+        got = []
+        c = ServiceIndexClient(primary.address, rank=r, batch=23, spec=spec,
+                               lookahead=4, backoff_base=0.01,
+                               reconnect_timeout=2.0)
+        try:
+            it = c.epoch_batches(0)
+            got.append(next(it))
+            b_streamed.wait(timeout=30.0)
+            b_killed.wait(timeout=30.0)
+            for arr in it:
+                got.append(arr)
+        finally:
+            with lock:
+                delivered[r] = (got, c.metrics.report()["counters"])
+            c.close()
+
+    threads = [threading.Thread(target=worker, args=(r,)) for r in range(2)]
+    try:
+        for t in threads:
+            t.start()
+        b_streamed.wait(timeout=30.0)
+        wait_synced(primary, standby)
+        primary.kill()
+        b_killed.wait(timeout=30.0)
+        for t in threads:
+            t.join(timeout=60.0)
+            assert not t.is_alive(), "pipelined failover worker hung"
+    finally:
+        primary.kill()
+        standby.stop()
+    assert standby.role == "primary", "standby never promoted"
+    for r in range(2):
+        got, counters = delivered[r]
+        ref = np.asarray(spec.rank_indices(0, r))
+        assert np.array_equal(np.concatenate(got), ref), (
+            f"rank {r} pipelined stream diverged across failover ({mode})")
+        assert counters.get("degraded_mode", 0) == 0
+
+
+# --------------------------------------------------- torn mid-pipeline
+def test_connection_torn_mid_pipeline_resumes_exactly_once():
+    """Tearing the socket while a pipelined window is in flight loses
+    every queued reply — all unacked — and the guarded path replays them
+    after the reconnect: one contiguous exactly-once stream."""
+    spec = build_spec("plain", 1)
+    with IndexServer(spec) as srv:
+        with ServiceIndexClient(srv.address, rank=0, batch=23, lookahead=4,
+                                backoff_base=0.01) as c:
+            got = []
+            it = c.epoch_batches(0)
+            for _ in range(3):
+                got.append(next(it))
+            c._sock.shutdown(2)  # tear mid-window, replies still queued
+            got.extend(it)
+            counters = c.metrics.report()["counters"]
+        assert counters.get("reconnects", 0) >= 1
+        assert np.array_equal(np.concatenate(got),
+                              np.asarray(spec.rank_indices(0, 0)))
+
+
+# ------------------------------------------------ loader boundary ring
+@pytest.mark.parametrize("mode", ["plain", "mixture", "shard"])
+def test_boundary_prefetch_bit_identical(mode):
+    """The loader's boundary-prefetch worker must be pure overlap: the
+    epoch streams with ``boundary_prefetch`` on and off are identical in
+    every spec mode, across the boundary the worker pre-computed."""
+    kw = {"batch": 23, "seed": 7, "rank": 0, "world": 2}
+    if mode == "plain":
+        args, extra = (np.arange(997),), {"window": 64}
+    elif mode == "mixture":
+        from partiallyshuffledistributedsampler_tpu.ops.mixture import (
+            MixtureSpec,
+        )
+        mx = MixtureSpec([400, 300, 200], [5, 3, 2], windows=32)
+        args, extra = (np.arange(900),), {"mixture": mx,
+                                          "epoch_samples": 600}
+    else:
+        sizes = [13, 7, 29, 17, 11, 23, 5, 19]
+        args, extra = (np.arange(sum(sizes)),), {"window": 4,
+                                                 "shard_sizes": sizes}
+    serial = HostDataLoader(*args, boundary_prefetch=False, **kw, **extra)
+    fused = HostDataLoader(*args, boundary_prefetch=True, **kw, **extra)
+    for epoch in range(3):
+        a = [np.asarray(b) for b in serial.epoch(epoch)]
+        # give the boundary worker a chance to win the race so the
+        # adopted-prefetch path (not just the fallback) is what's tested
+        time.sleep(0.05)
+        b = [np.asarray(x) for x in fused.epoch(epoch)]
+        assert len(a) == len(b)
+        for x, y in zip(a, b):
+            assert np.array_equal(x, y), (
+                f"boundary prefetch changed the stream at epoch {epoch}")
