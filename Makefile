@@ -52,6 +52,12 @@
 #                          path: lookahead-vs-guarded bit-identity across
 #                          epoch boundaries/reshard/failover, then the
 #                          fusion-speedup + boundary-overlap bars
+#   * sharding smoke       tests/test_sharding.py (`-m sharding`)
+#                          + benchmarks/sharding_smoke.py — sharded
+#                          serving plane: 3-shard bit-identity matrix,
+#                          shard failover, cross-shard reshard barrier,
+#                          router restart, then the p99-flat-across-
+#                          shards bar under the client sweep
 #   * analyze              project-native static analysis (docs/ANALYSIS.md):
 #                          guarded-by discipline, fault-site/protocol/
 #                          metrics-docs drift, clock discipline, silent-
@@ -65,7 +71,7 @@ PY ?= python
 
 .PHONY: check test bench native dryrun service-smoke chaos-smoke \
 	elastic-smoke telemetry-smoke failover-smoke tenancy-smoke \
-	durability-smoke fused-smoke analyze analysis-smoke
+	durability-smoke fused-smoke sharding-smoke analyze analysis-smoke
 
 # the driver parses the LAST line of bench.py's combined output (round 3
 # lost its headline to the details line — BENCH_r03.json "parsed": null),
@@ -143,6 +149,14 @@ durability-smoke:
 fused-smoke:
 	$(PY) -m pytest tests/test_fused.py -q -m fused -ra
 	$(PY) benchmarks/fused_smoke.py
+
+# sharded serving plane gate (docs/SHARDING.md): the shard-map /
+# bit-identity / failover / cross-shard-barrier / router-restart suite,
+# then the rpc_ms-p99-flat-across-shards smoke under the concurrent-
+# client sweep
+sharding-smoke:
+	$(PY) -m pytest tests/test_sharding.py -q -m sharding -ra
+	$(PY) benchmarks/sharding_smoke.py
 
 # static-analysis gate (docs/ANALYSIS.md): every lint pass over the
 # package + docs; any finding is a non-zero exit with file:line output

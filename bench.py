@@ -449,6 +449,21 @@ def main() -> None:
         except Exception as exc:
             details["fused_error"] = repr(exc)[:200]
 
+    # detail tier: sharding — rpc_ms p99 at 1/2/4 shards behind the
+    # rank-space router under the concurrent-client sweep; the max-shard
+    # tail must hold within the single-shard arm's noise (methodology in
+    # benchmarks/sharding_smoke.py)
+    if not smoke:
+        try:
+            sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+            from benchmarks.sharding_smoke import (
+                summarize as sharding_summarize,
+            )
+
+            details["sharding"] = sharding_summarize()
+        except Exception as exc:
+            details["sharding_error"] = repr(exc)[:200]
+
     # detail tier: analysis — concurrency-sanitizer overhead: the
     # tracked-lock arm must stay within the raw-lock arm's rep noise
     # and record zero lock-order cycles (methodology in

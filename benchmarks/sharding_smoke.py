@@ -1,0 +1,173 @@
+"""Sharded serving plane smoke: N shards must hold the per-request tail
+flat as the concurrent-client population grows — sharding is horizontal
+headroom, never a per-request tax.
+
+Two consumers:
+
+* ``make sharding-smoke`` / ``python benchmarks/sharding_smoke.py`` —
+  the CI gate: every rank dials the ROUTER and streams its epoch
+  direct-connected to its shard, at 1, 2 and 4 shards across a
+  concurrent-client sweep.  Assert the folded stream is bit-identical
+  to the spec at every point of the grid, and that the max-shard
+  ``rpc_ms`` p99 stays within the single-shard arm's own rep-to-rep
+  noise at every client count (``sharding_within_noise`` — on loopback
+  the dispatch loop a shard relieves is microseconds, so the honest CI
+  bar is "never slower"; the headline on real fleets is the ceiling
+  multiplying).  Exit 0 and one JSON line on success; raises otherwise.
+
+* ``bench.py`` imports :func:`summarize` for ``details["sharding"]``.
+
+Methodology mirrors fused_smoke: fixed total work per grid point (the
+epoch shrinks per rank as the client count grows), guarded
+``lookahead=1`` clients so every step is one real request-reply
+``rpc_ms`` sample, the single-shard arm repeated ``reps`` times and its
+p99 spread (plus a small absolute floor) is the noise bar
+(docs/SHARDING.md "Scaling law").
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+#: loopback p99 spread can be ~0 across reps; keep slack for scheduler
+#: jitter under hundreds of concurrent client threads (ms per request)
+_NOISE_FLOOR_P99_MS = 2.0
+
+
+def _one_plane(spec, n_shards: int, batch: int):
+    """Every rank streams its epoch through the plane concurrently;
+    returns (per-request ms samples, folded stream sorted by rank)."""
+    from partiallyshuffledistributedsampler_tpu.service import (
+        ServiceIndexClient,
+    )
+    from partiallyshuffledistributedsampler_tpu.sharding import ShardPlane
+
+    durations: list = []
+    folded: dict = {}
+    lock = threading.Lock()
+    errors: list = []
+    with ShardPlane(spec, n_shards) as plane:
+        # warm every shard's epoch cache first (one stream per shard),
+        # so the timed samples measure the serve path, not the one-off
+        # epoch regen a cold shard pays on its first request
+        for sid in range(n_shards):
+            lo, hi = plane.map.ranks(sid)
+            if lo < min(hi, spec.world):
+                with ServiceIndexClient(plane.shards[sid].address,
+                                        rank=lo, batch=batch) as warm:
+                    for _ in warm.epoch_batches(0):
+                        pass
+
+        def worker(rank: int) -> None:
+            local, got = [], []
+            try:
+                c = ServiceIndexClient(plane.address, rank=rank,
+                                       batch=batch, lookahead=1,
+                                       backoff_base=0.01,
+                                       reconnect_timeout=30.0)
+                try:
+                    it = c.epoch_batches(0)
+                    while True:
+                        t0 = time.perf_counter()
+                        try:
+                            arr = next(it)
+                        except StopIteration:
+                            break
+                        local.append((time.perf_counter() - t0) * 1e3)
+                        got.append(arr)
+                finally:
+                    c.close()
+            except Exception as exc:  # surfaced to the caller below
+                with lock:
+                    errors.append((rank, exc))
+                return
+            with lock:
+                # the first step per client carries the dial + HELLO +
+                # lease claim; the steady-state rpc is what scales
+                durations.extend(local[1:])
+                folded[rank] = (np.concatenate(got) if got
+                                else np.empty(0, np.int64))
+
+        threads = [threading.Thread(target=worker, args=(r,))
+                   for r in range(spec.world)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120.0)
+    if errors:
+        raise AssertionError(f"sharded clients failed: {errors[:3]!r}")
+    stream = np.concatenate([folded[r] for r in range(spec.world)])
+    return durations, stream
+
+
+def _client_sweep(n: int, window: int, batch: int,
+                  shard_counts, client_counts, reps: int) -> dict:
+    from partiallyshuffledistributedsampler_tpu.service import (
+        PartialShuffleSpec,
+    )
+
+    max_shards = max(shard_counts)
+    out: dict = {"points": []}
+    all_within = True
+    for clients in client_counts:
+        spec = PartialShuffleSpec.plain(n, window=window, seed=0,
+                                        world=clients)
+        ref = np.concatenate([np.asarray(spec.rank_indices(0, r))
+                              for r in range(clients)])
+        point: dict = {"clients": clients}
+        # every arm repeats, interleaved so machine drift hits all arms
+        # equally; the single-shard arm's p99 spread is the noise bar
+        p99s: dict = {s: [] for s in shard_counts}
+        for _ in range(reps):
+            for n_shards in shard_counts:
+                durs, stream = _one_plane(spec, n_shards, batch)
+                if not np.array_equal(stream, ref):
+                    raise AssertionError(
+                        f"folded stream diverged at {n_shards} shards x "
+                        f"{clients} clients — sharding must never "
+                        "change the data")
+                p99s[n_shards].append(float(np.percentile(durs, 99)))
+        noise = max(max(p99s[1]) - min(p99s[1]), _NOISE_FLOOR_P99_MS)
+        base = float(np.median(p99s[1]))
+        point["rpc_p99_ms"] = {s: round(float(np.median(v)), 3)
+                               for s, v in p99s.items()}
+        point["noise_ms"] = round(noise, 3)
+        worst = float(np.median(p99s[max_shards]))
+        point["within_noise"] = bool(worst - base <= noise)
+        all_within = all_within and point["within_noise"]
+        out["points"].append(point)
+    out["shard_counts"] = list(shard_counts)
+    out["sharding_within_noise"] = all_within
+    return out
+
+
+def summarize(*, n: int = 32_768, window: int = 256, batch: int = 64,
+              shard_counts=(1, 2, 4), client_counts=(8, 64, 256),
+              reps: int = 3) -> dict:
+    """The ``details["sharding"]`` tier: ``rpc_ms`` p99 at 1/2/4 shards
+    under the concurrent-client sweep, against the single-shard noise."""
+    out: dict = {"n": n, "batch": batch, "reps": reps}
+    out.update(_client_sweep(n, window, batch, shard_counts,
+                             client_counts, reps))
+    return out
+
+
+def main() -> None:
+    """The `make sharding-smoke` gate: hard assertions, one JSON line."""
+    report = summarize(n=16_384, client_counts=(8, 32), reps=3)
+    assert report["sharding_within_noise"], (
+        "the 4-shard rpc_ms p99 left the single-shard noise band at "
+        f"some client count: {report['points']!r}")
+    print(json.dumps({"sharding_smoke": "ok", **report}))
+
+
+if __name__ == "__main__":
+    main()

@@ -372,6 +372,14 @@ def lint_protocol(root: Path) -> List[Finding]:
     msgs = _msg_constants(_read(proto_path))
     server_src = _read(server_path)
     dispatched, emitted = _server_arms(server_src)
+    # the sharded serving plane (sharding/) speaks the same protocol:
+    # its dispatch arms, emitted opcodes and sent error codes count too
+    sharding = root / _PKG / "sharding"
+    shard_paths = sorted(sharding.glob("*.py")) if sharding.is_dir() else []
+    for sp in shard_paths:
+        d2, e2 = _server_arms(_read(sp))
+        dispatched |= d2
+        emitted |= e2
     findings: List[Finding] = []
 
     refs: set = set()
@@ -395,14 +403,17 @@ def lint_protocol(root: Path) -> List[Finding]:
     handled = (_str_constants(_read(svc / "client.py"))
                | _str_constants(_read(svc / "replication.py"))
                | _ERROR_CODE_PASSTHROUGH)
-    rel_server = str(server_path.relative_to(root))
-    for code, line in sorted(_sent_error_codes(server_src).items()):
-        if code not in handled:
-            findings.append(Finding(
-                "protocol", rel_server, line,
-                f"server sends ERROR code {code!r} but neither client.py "
-                f"nor replication.py handles it (add a handler or list it "
-                f"in _ERROR_CODE_PASSTHROUGH with its doc section)"))
+    for src_path in [server_path] + shard_paths:
+        src = server_src if src_path == server_path else _read(src_path)
+        rel = str(src_path.relative_to(root))
+        for code, line in sorted(_sent_error_codes(src).items()):
+            if code not in handled:
+                findings.append(Finding(
+                    "protocol", rel, line,
+                    f"server sends ERROR code {code!r} but neither "
+                    f"client.py nor replication.py handles it (add a "
+                    f"handler or list it in _ERROR_CODE_PASSTHROUGH with "
+                    f"its doc section)"))
     return findings
 
 

@@ -33,6 +33,8 @@ SITES = frozenset({
     "client.leave",          # a client announcing its preemption drain
     "client.pipeline",       # the pipelined client topping up its window
     "tenant.admission",      # a HELLO admitting / creating a tenant
+    "router.route",          # the shard router resolving a HELLO's shard
+    "shard.barrier",         # a cross-shard set_epoch / reshard fan-out
     "loader.prefetch",       # one step of HostDataLoader's gather thread
     "loader.regen",          # local epoch index generation
     "loader.boundary",       # the epoch-boundary prefetch worker fetching

@@ -240,6 +240,14 @@ class ServiceIndexClient:
         #: stamped on every request so a re-dial of a multi-tenant daemon
         #: lands back in the same tenant even before the re-HELLO binds us
         self.tenant: Optional[str] = None
+        #: the deployment's rank→shard map (raw wire dict), adopted from
+        #: a router WELCOME or a ``wrong_shard`` refusal; ``None`` on an
+        #: unsharded deployment (docs/SHARDING.md)
+        self.shard_map: Optional[dict] = None
+        #: where the router listens, remembered at the first router
+        #: WELCOME — the fallback re-route target when an adopted map
+        #: carries no address for our shard
+        self._router_address: Optional[tuple] = None
         self.spec_wire: Optional[dict] = None
         self.server_epoch: Optional[int] = None
         self._sock: Optional[socket.socket] = None
@@ -273,7 +281,79 @@ class ServiceIndexClient:
         self._failover_t0: Optional[float] = None
 
     # ----------------------------------------------------------- connection
+    #: dial → redirect hops one ``_connect`` tolerates before handing the
+    #: churn (a staggered cross-shard commit ping-pongs a migrating rank
+    #: between the old and new owner) to the retry layer's paced loop
+    _MAX_REDIRECT_HOPS = 6
+
+    def _adopt_shard_map(self, wire) -> bool:
+        """Version-gated map adoption: during a staggered cross-shard
+        commit both the old and the new owner refuse a migrating rank,
+        each attaching its own map — only a version >= ours may replace
+        the adopted one (docs/SHARDING.md)."""
+        if not wire:
+            return False
+        cur = self.shard_map
+        if cur is not None and \
+                int(wire.get("version", 1)) < int(cur.get("version", 1)):
+            return False
+        self.shard_map = dict(wire)
+        return True
+
+    def _shard_owner_addr(self, rank) -> Optional[tuple]:
+        """The owning shard's address per the adopted map (``None``
+        without a map, or when the map has no address for it); rankless
+        auto-claim clients go to the first non-empty slice."""
+        m = self.shard_map
+        if m is None:
+            return None
+        for sh in m.get("shards", ()):
+            lo, hi = int(sh["ranks"][0]), int(sh["ranks"][1])
+            if hi <= lo:
+                continue
+            a = sh.get("addr")
+            if rank is None:
+                if a is not None:
+                    return _parse_address(tuple(a))
+                continue
+            if lo <= int(rank) < hi:
+                return None if a is None else _parse_address(tuple(a))
+        return None
+
+    def _on_wrong_shard(self, hdr: dict) -> None:
+        """A shard refused our rank: adopt the attached (fresh) map and
+        re-point at the owner — falling back to the router when the map
+        carries no address for it."""
+        self._adopt_shard_map(hdr.get("shard_map"))
+        self.metrics.inc("wrong_shard_redirects", self.rank)
+        target = self._shard_owner_addr(self.rank)
+        if target is None:
+            target = self._router_address
+        if target is not None and target != self.address:
+            self.close()
+            self.address = target
+
     def _connect(self) -> None:
+        last_refusal = None
+        for _ in range(self._MAX_REDIRECT_HOPS):
+            done, last_refusal = self._connect_once()
+            if done:
+                return
+        if last_refusal is not None:
+            # still ping-ponging (a staggered commit in flight): surface
+            # the typed refusal so the retry layer paces the re-route
+            raise _typed_error("wrong_shard",
+                               last_refusal.get("detail", ""), last_refusal)
+        raise ServiceUnavailable(
+            f"still redirected toward {self.address} after "
+            f"{self._MAX_REDIRECT_HOPS} hops; the shard map may be "
+            "missing addresses")
+
+    def _connect_once(self):
+        """One dial + HELLO.  Returns ``(True, None)`` once a data-plane
+        WELCOME is adopted; ``(False, refusal-or-None)`` when a router
+        WELCOME or a ``wrong_shard`` refusal re-pointed ``self.address``
+        at the owning shard (the caller loops, bounded)."""
         sock = socket.create_connection(self.address, timeout=self.timeout)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         sock.settimeout(self.timeout)
@@ -307,6 +387,9 @@ class ServiceIndexClient:
             raise
         if msg == P.MSG_ERROR:
             sock.close()
+            if header.get("code") == "wrong_shard":
+                self._on_wrong_shard(header)
+                return False, header
             raise _typed_error(header.get("code", "error"),
                                header.get("detail", ""), header)
         if msg != P.MSG_WELCOME:
@@ -314,6 +397,23 @@ class ServiceIndexClient:
             raise P.ProtocolError(
                 f"expected WELCOME, got {P.msg_name(msg)}"
             )
+        if header.get("router"):
+            # a ShardRouter answered: it never serves data — remember it,
+            # adopt the map it carries and direct-connect the owning
+            # shard (docs/SHARDING.md)
+            sock.close()
+            self._router_address = self.address
+            self._adopt_shard_map(header.get("shard_map"))
+            target = self._shard_owner_addr(self.rank)
+            if target is None or target == self.address:
+                raise ServiceUnavailable(
+                    f"router at {self.address} advertised no shard "
+                    f"address for rank {self.rank}")
+            self.address = target
+            return False, None
+        sm = header.get("shard_map")
+        if sm is not None:
+            self._adopt_shard_map(sm)
         self.rank = int(header["rank"])
         t = header.get("tenant")
         if t is not None:
@@ -336,6 +436,7 @@ class ServiceIndexClient:
             self.metrics.registry.histogram("failover_ms").observe(
                 (time.perf_counter() - self._failover_t0) * 1e3)
             self._failover_t0 = None
+        return True, None
 
     def _adopt_membership(self, header: dict) -> None:
         """Take on the membership a WELCOME or ``resharded`` error carries.
@@ -486,6 +587,16 @@ class ServiceIndexClient:
                         if not op.pause(min_delay=retry_s):
                             raise
                         continue
+                    if exc.code in ("wrong_shard", "router_route"):
+                        # shard-map churn (a staggered cross-shard commit
+                        # ping-pongs a migrating rank between owners) or
+                        # an injected route fault: the re-route already
+                        # happened in _connect — pace and re-dial
+                        retry_s = float(
+                            exc.header.get("retry_ms", 25)) / 1e3
+                        if not op.pause(min_delay=retry_s):
+                            raise
+                        continue
                     if exc.code not in ("rank_taken", "not_owner"):
                         raise
                     # our own just-dropped lease may not have been released
@@ -565,6 +676,27 @@ class ServiceIndexClient:
                             f"reshard barrier at {self.address} did not "
                             "commit within the retry deadline"
                         )
+                    continue
+                if code == "wrong_shard":
+                    # our rank moved shards (a cross-shard reshard
+                    # commit): adopt the attached map, re-point at the
+                    # owner and re-HELLO there (docs/SHARDING.md)
+                    self.close()
+                    self._on_wrong_shard(rheader)
+                    retry_s = float(rheader.get("retry_ms", 25)) / 1e3
+                    if not op.pause(min_delay=retry_s):
+                        raise ServiceError(code, rheader.get("detail", ""),
+                                           rheader)
+                    continue
+                if code in ("router_route", "shard_barrier"):
+                    # transient control-plane trouble (an injected route
+                    # fault, or a cross-shard barrier fan-out that did
+                    # not complete): every frame we send is idempotent,
+                    # so pace and replay
+                    retry_s = float(rheader.get("retry_ms", 50)) / 1e3
+                    if not op.pause(min_delay=retry_s):
+                        raise ServiceError(code, rheader.get("detail", ""),
+                                           rheader)
                     continue
                 if code == "standby":
                     # the peer demoted/never promoted under us
@@ -700,7 +832,6 @@ class ServiceIndexClient:
         barriers, so it must ride `_rpc`'s reshard-wait machinery, not a
         fire-and-forget pipeline slot."""
         sock = self._sock
-        w = self._pipe_limit()
         hist = self.metrics.registry.histogram("step_serve_ms")
         pending = deque()        # requested-but-unconsumed seqs, in order
         next_req = seq
@@ -713,6 +844,15 @@ class ServiceIndexClient:
         #                          than one request is committed)
         try:
             while True:
+                # re-read the clamp every iteration: a failover re-HELLO
+                # can adopt a SMALLER max_inflight mid-stream, and an
+                # already-ramped window must shrink to it — no new
+                # request is sent until the in-flight span drains below
+                # the new limit, so the standby never sees a window the
+                # dead primary negotiated
+                w = self._pipe_limit()
+                if ramp > w:
+                    ramp = w
                 msgs = []
                 while len(pending) < min(w, ramp) and (bound is None
                                                        or next_req < bound):

@@ -84,6 +84,24 @@ and ``client_fingerprint``, plus ``tenants``/``max_tenants`` when the
 refusal was a capacity limit), ``tenant_admission`` (retryable — a
 per-tenant quota refused the HELLO; the header carries ``retry_ms``).
 
+Sharding fields and codes (docs/SHARDING.md): a ``ShardRouter``'s
+``WELCOME`` carries ``router=true`` plus the deployment's ``shard_map``
+(``{version, world, shards:[{id, ranks:[lo,hi), addr}], fingerprint}``)
+and assigns no rank — the client direct-connects the owning shard; a
+shard's ``WELCOME`` rides ``shard`` and the same ``shard_map``.  ``HELLO``
+MAY carry ``attach=true`` to admit/create a tenant namespace WITHOUT
+claiming a rank lease (answered ``OK`` with the ``tenant`` id).
+``RESHARD`` MAY carry ``phase`` (``prepare`` | ``commit`` | ``abort``)
+for the router's two-phase cross-shard barrier — ``commit`` imposes the
+global ``barrier_units``, the post-barrier ``map``, and ``dead_ranks``
+(sent only to the shard owning rank 0, which serves the orphan prefix).
+All are additive header fields inside protocol version 2.  Error codes:
+``wrong_shard`` (retryable — the dialed shard does not own the rank; the
+header carries ``retry_ms``, ``owner`` and a fresh ``shard_map`` so the
+client re-routes without a router round-trip), ``router_route`` (an
+injected route fault; retryable), ``shard_barrier`` (a cross-shard
+fan-out did not complete; retryable — barrier requests are idempotent).
+
 Tracing: any request header MAY carry ``trace=[trace_id, span_id]`` —
 the sender's open span context (docs/OBSERVABILITY.md).  Receivers that
 know about it parent their dispatch span under it; receivers that don't

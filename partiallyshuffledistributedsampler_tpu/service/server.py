@@ -113,6 +113,7 @@ from ..utils.checkpoint import (
     tenant_snapshot_path,
 )
 from . import protocol as P
+from .dispatch import DispatchListener
 from .metrics import ServiceMetrics
 from .replication import ReplicationLog, ReplicationShipper, TenantTaggedLog
 from .spec import PartialShuffleSpec
@@ -137,7 +138,7 @@ def _state_crc(state: dict) -> int:
     return zlib.crc32(body) & 0xFFFFFFFF
 
 
-class IndexServer:
+class IndexServer(DispatchListener):
     """Threaded loopback daemon serving one spec's index streams.
 
         spec = PartialShuffleSpec.plain(n, window=8192, world=4)
@@ -313,17 +314,8 @@ class IndexServer:
         self._stop.clear()
         self._draining.clear()
         self._recover_from_disk()
-        ls = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        ls.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        ls.bind((self.host, self.port))
-        ls.listen(128)
-        ls.settimeout(0.2)  # the accept loop doubles as the lease sweeper
-        self.host, self.port = ls.getsockname()[:2]
-        self._listener = ls
-        t = threading.Thread(target=self._accept_loop, daemon=True,
-                             name="psds-service-accept")
-        t.start()
-        self._threads.append(t)
+        # the accept loop (service/dispatch.py) doubles as the lease sweeper
+        self._listener_bind()
         if self.role == "primary" and (self._standby_addr is not None
                                        or self._wal is not None):
             # the log exists whenever there is somewhere for records to
@@ -1199,33 +1191,9 @@ class IndexServer:
             return arr
 
     # --------------------------------------------------------------- accept
-    def _accept_loop(self) -> None:
-        while not self._stop.is_set():
-            ls = self._listener
-            if ls is None:
-                return
-            try:
-                sock, _addr = ls.accept()
-            except socket.timeout:
-                self._sweep_leases()
-                continue
-            except OSError:
-                return  # listener closed by stop()
-            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            with self._lock:
-                conn_id = self._next_conn_id
-                self._next_conn_id += 1
-                self._conn_socks[conn_id] = sock
-            t = threading.Thread(
-                target=self._serve_conn, args=(sock, conn_id), daemon=True,
-                name=f"psds-service-conn-{conn_id}",
-            )
-            t.start()
-            # prune finished serve threads while appending: a long-lived
-            # daemon churning reconnects must not accumulate dead Thread
-            # objects (and stop() must not re-join them)
-            self._threads = [x for x in self._threads if x.is_alive()]
-            self._threads.append(t)
+    def _on_accept_tick(self) -> None:
+        # DispatchListener hook: the accept timeout is the sweep tick
+        self._sweep_leases()
 
     def _sweep_leases(self) -> None:
         """Evict ranks whose connection went silent past the lease timeout
@@ -1322,60 +1290,26 @@ class IndexServer:
                 pass
 
     # ------------------------------------------------------- per-connection
-    def _serve_conn(self, sock: socket.socket, conn_id: int) -> None:
-        try:
-            while not self._stop.is_set():
-                try:
-                    msg, header, payload = P.recv_msg(sock)
-                except P.ProtocolError as exc:
-                    # best-effort complaint, then drop the broken peer
-                    try:
-                        P.send_msg(sock, P.MSG_ERROR,
-                                   {"code": "protocol", "detail": str(exc)})
-                    except OSError:
-                        pass
-                    return
-                t0 = time.perf_counter()
-                eng = (self._conn_tenant.get(conn_id, self)
-                       if self.multi_tenant else self)
-                try:
-                    if telemetry.enabled():
-                        extra = {"tenant": eng.tenant_id} \
-                            if self.multi_tenant else {}
-                        # the span wraps the fault-injection point too,
-                        # so a dump triggered by an injected dispatch
-                        # fault shows the request being served when it
-                        # fired
-                        with _span("server." + P.msg_name(msg),
-                                   trace=header.get("trace"), conn=conn_id,
-                                   rank=header.get("rank"), **extra):
-                            F.fire("server.dispatch")
-                            self._dispatch(sock, conn_id, msg, header,
-                                           payload)
-                    else:
-                        # tracing off: no span, no kwargs dict, no name
-                        # concat on the per-request hot path
-                        F.fire("server.dispatch")
-                        self._dispatch(sock, conn_id, msg, header, payload)
-                except OSError:
-                    return  # peer vanished mid-reply
-                if msg == P.MSG_GET_BATCH:
-                    eng.metrics.registry.histogram(
-                        "batch_service_ms"
-                    ).observe((time.perf_counter() - t0) * 1e3)
-        except (ConnectionError, OSError):
-            return
-        except F.InjectedThreadDeath:
-            return  # injected serve-thread death; cleanup below still runs
-        finally:
-            teng = self._conn_tenant.pop(conn_id, None)
-            if teng is not None:
-                teng._release_conn(conn_id)
-            self._release_conn(conn_id)
-            try:
-                sock.close()
-            except OSError:
-                pass
+    # the serve loop itself lives in DispatchListener (service/dispatch.py);
+    # these hooks bind it to tenant routing, batch timing and lease release
+    def _conn_engine(self, conn_id: int) -> "IndexServer":
+        return (self._conn_tenant.get(conn_id, self)
+                if self.multi_tenant else self)
+
+    def _span_extra(self, eng) -> dict:
+        return {"tenant": eng.tenant_id} if self.multi_tenant else {}
+
+    def _observe_dispatch(self, eng, msg, t0: float) -> None:
+        if msg == P.MSG_GET_BATCH:
+            eng.metrics.registry.histogram(
+                "batch_service_ms"
+            ).observe((time.perf_counter() - t0) * 1e3)
+
+    def _conn_cleanup(self, conn_id: int) -> None:
+        teng = self._conn_tenant.pop(conn_id, None)
+        if teng is not None:
+            teng._release_conn(conn_id)
+        self._release_conn(conn_id)
 
     def _release_conn(self, conn_id: int) -> None:
         """A closed connection releases its leases at once — a crashed
@@ -1582,6 +1516,190 @@ class IndexServer:
         return {"code": "resharded", "detail": detail,
                 **self._membership_locked()}
 
+    def _consumption_locked(self, epoch: int, world: int):
+        """Per-rank ``(samples, covered)`` watermarks at ``epoch`` —
+        samples served vs samples ACKED delivered.  Under ``self._lock``."""
+        samples = {
+            r: (int(self._cursors[r].get("samples", 0))
+                if r in self._cursors
+                and self._cursors[r]["epoch"] == epoch else 0)
+            for r in range(world)
+        }
+        covered = {}
+        for r in range(world):
+            cur = self._cursors.get(r)
+            b = int(self._leases.get(r, {}).get("batch") or 0)
+            covered[r] = (
+                (int(cur["acked"]) + 1) * b
+                if cur is not None and cur["epoch"] == epoch and b > 0
+                else 0
+            )
+        return samples, covered
+
+    def _unit_watermarks(self, epoch: int, world: int, layers,
+                         orphan_len: int, samples: dict):
+        """Per-rank unit cumsums (shard mode) and whole base units
+        STARTED: sample ``s-1`` lives in unit ``u-1``.  Call OUTSIDE the
+        lock — shard mode may regenerate the epoch's shard draws."""
+        shard = self.spec.mode == "shard"
+        cums = {}
+        if shard:
+            for r in range(world):
+                sizes = np.asarray(self.spec.rank_unit_sizes(
+                    epoch, r, layers=layers), dtype=np.int64)
+                cums[r] = np.concatenate(([0], np.cumsum(sizes)))
+        units = {}
+        for r in range(world):
+            s = max(0, samples[r] - (orphan_len if r == 0 else 0))
+            units[r] = (int(np.searchsorted(cums[r], s, side="left"))
+                        if shard else s)
+        return cums, units
+
+    def _reshard_prepare(self, target_world: int):
+        """Phase 1 of a cross-shard barrier (docs/SHARDING.md): freeze
+        serving and report this server's consumption maximum in whole
+        base units.  Unlike :meth:`_trigger_reshard`, the frozen barrier
+        does NOT flip to drain — the coordinating router gathers every
+        shard's maximum, takes the global max ``C``, and imposes it via
+        :meth:`_reshard_commit_prepared` (or unfreezes the abandoned
+        prepare via :meth:`_reshard_abort_prepared`).  Returns ``None``
+        when another reshard is already in flight, else
+        ``{"epoch", "world", "units_max"}``."""
+        F.fire("server.reshard")
+        target_world = int(target_world)
+        if target_world < 1:
+            raise ValueError(f"target_world must be >= 1, got {target_world}")
+        t_freeze = time.perf_counter()
+        with self._lock:
+            if self._reshard is not None or self._draining.is_set():
+                return None
+            world = self.spec.world
+            epochs = [c["epoch"] for c in self._cursors.values()]
+            epoch = max(epochs) if epochs else self.epoch
+            self._reshard = {"phase": "freeze",
+                             "target_world": target_world, "epoch": epoch}
+            layers = self._gen_layers_locked(epoch)
+            orphan_len = self._orphan_len_locked(epoch)
+            samples, covered = self._consumption_locked(epoch, world)
+        try:
+            _cums, units = self._unit_watermarks(epoch, world, layers,
+                                                 orphan_len, samples)
+            with self._lock:
+                rs = self._reshard
+                if rs is None:  # aborted while we computed
+                    return None
+                # in-memory scratch only: _state_dict_locked persists
+                # drain-phase barriers, so a daemon crashed mid-prepare
+                # restarts unfrozen and the router simply retries
+                rs["prep"] = {"epoch": int(epoch), "world": int(world),
+                              "covered": covered, "t_freeze": t_freeze}
+            return {"epoch": int(epoch), "world": int(world),
+                    "units_max": int(max(units.values(), default=0))}
+        except BaseException:
+            # a failed prepare must unfreeze, or every future GET_BATCH
+            # draws an endless retry and the shard is bricked
+            with self._lock:
+                self._reshard = None
+            telemetry.auto_dump("reshard_abort")
+            raise
+
+    def _reshard_commit_prepared(self, barrier_units: int, *,
+                                 participants=None, dead=None,
+                                 leaving=None) -> bool:
+        """Phase 2 of a cross-shard barrier: set per-rank drain targets
+        from the imposed GLOBAL barrier ``C`` and flip the prepared
+        freeze to drain.  ``participants`` restricts the drain gate to
+        the ranks this server actually serves (its shard slice);
+        ``dead`` adds coordinator-declared dead ranks whose un-served
+        allocation is re-homed here as orphan descriptors (the router
+        sends those only to the shard owning rank 0, where orphan
+        prefixes are served).  The commit itself then proceeds exactly
+        as a local reshard — whichever request or sweep observes the
+        last drain wins.  Returns False when no prepared barrier is in
+        flight."""
+        barrier = int(barrier_units)
+        with self._lock:
+            rs = self._reshard
+            if (rs is None or rs.get("phase") != "freeze"
+                    or "prep" not in rs):
+                return False
+            prep = rs["prep"]
+            epoch, world = prep["epoch"], prep["world"]
+            layers = self._gen_layers_locked(epoch)
+            orphan_len = self._orphan_len_locked(epoch)
+        # shard-mode cumsums regenerate draws — outside the lock (the
+        # prepared freeze pauses serving, so watermarks cannot move)
+        shard = self.spec.mode == "shard"
+        cums = {}
+        if shard:
+            for r in range(world):
+                sizes = np.asarray(self.spec.rank_unit_sizes(
+                    epoch, r, layers=layers), dtype=np.int64)
+                cums[r] = np.concatenate(([0], np.cumsum(sizes)))
+        ranks = sorted(
+            {int(r) for r in (participants if participants is not None
+                              else range(world))}
+            | {int(r) for r in (dead or ())}
+        )
+        with self._lock:
+            rs = self._reshard
+            if rs is None or rs.get("phase") != "freeze":
+                return False
+            covered = prep["covered"]
+            targets = {}
+            now = self._clock()
+            for r in ranks:
+                t = int(cums[r][barrier]) if shard else barrier
+                if r == 0:
+                    t += orphan_len
+                targets[r] = t
+                lease = self._leases.get(r)
+                if lease is None or lease.get("owner") is None:
+                    self._vacated.setdefault(r, now)
+            rs.pop("prep", None)
+            rs.update(
+                phase="drain",
+                barrier_units=barrier,
+                targets=targets,
+                drained={r for r in ranks
+                         if r not in set(dead or ()) and
+                         covered.get(r, 0) >= targets[r]},
+                leaving=dict(leaving or {}),
+                dead={int(r) for r in (dead or ())},
+            )
+            rs["t_drain"] = time.perf_counter()
+            self.metrics.inc("reshard_triggers")
+            # the freeze→drain flip ships wholesale: the standby
+            # applies barriers with the snapshot-restore code path
+            self._repl_append("state", state=self._state_dict_locked())
+        self.metrics.registry.histogram("barrier_freeze_ms").observe(
+            (rs["t_drain"] - prep["t_freeze"]) * 1e3)
+        telemetry.event("reshard_drain",
+                        target_world=int(rs["target_world"]),
+                        barrier_units=barrier)
+        with self._lock:
+            try:
+                self._commit_reshard_locked()
+            except F.InjectedThreadDeath:
+                raise
+            except Exception:  # lint: allow-broad-except(injected commit fault; retried)
+                pass
+        self._write_snapshot(force=True)
+        return True
+
+    def _reshard_abort_prepared(self) -> bool:
+        """Unfreeze a prepared (phase-1) barrier the coordinator
+        abandoned — e.g. a sibling shard refused its prepare.  A
+        drain-phase barrier is never aborted here: it is already
+        replicated and will commit through the normal drain path."""
+        with self._lock:
+            rs = self._reshard
+            if rs is None or rs.get("phase") != "freeze":
+                return False
+            self._reshard = None
+        telemetry.event("reshard_prepare_aborted")
+        return True
+
     def _trigger_reshard(self, target_world: int, *, leaving=None,
                          dead=None) -> bool:
         """Freeze a reshard barrier and enter the drain phase.
@@ -1613,39 +1731,15 @@ class IndexServer:
                              "target_world": target_world, "epoch": epoch}
             layers = self._gen_layers_locked(epoch)
             orphan_len = self._orphan_len_locked(epoch)
-            samples = {
-                r: (int(self._cursors[r].get("samples", 0))
-                    if r in self._cursors
-                    and self._cursors[r]["epoch"] == epoch else 0)
-                for r in range(world)
-            }
-            covered = {}
-            for r in range(world):
-                cur = self._cursors.get(r)
-                b = int(self._leases.get(r, {}).get("batch") or 0)
-                covered[r] = (
-                    (int(cur["acked"]) + 1) * b
-                    if cur is not None and cur["epoch"] == epoch and b > 0
-                    else 0
-                )
+            samples, covered = self._consumption_locked(epoch, world)
         try:
             # unit structure may regenerate shard draws — outside the lock
             # (the freeze phase pauses serving, so watermarks cannot move:
             # new requests are refused at admission, and a request already
             # past admission is refused at its counting tail)
             shard = self.spec.mode == "shard"
-            cums = {}
-            if shard:
-                for r in range(world):
-                    sizes = np.asarray(self.spec.rank_unit_sizes(
-                        epoch, r, layers=layers), dtype=np.int64)
-                    cums[r] = np.concatenate(([0], np.cumsum(sizes)))
-            units = {}
-            for r in range(world):
-                s = max(0, samples[r] - (orphan_len if r == 0 else 0))
-                # whole units STARTED: sample s-1 lives in unit u-1
-                units[r] = (int(np.searchsorted(cums[r], s, side="left"))
-                            if shard else s)
+            cums, units = self._unit_watermarks(epoch, world, layers,
+                                                orphan_len, samples)
             barrier = max(units.values(), default=0)
             with self._lock:
                 rs = self._reshard
@@ -1914,6 +2008,12 @@ class IndexServer:
         engine = self._route_hello(sock, header)
         if engine is None:
             return  # refusal already sent
+        if header.get("attach"):
+            # additive (docs/SHARDING.md): admit the namespace WITHOUT
+            # claiming a rank lease — the shard router pre-attaches a
+            # tenant on every shard that owns some of its ranks
+            P.send_msg(sock, P.MSG_OK, {"tenant": engine.tenant_id})
+            return
         if engine is not self:
             # bind the connection to its tenant: subsequent frames route
             # without re-stating the namespace, and the engine's sweeps
@@ -2102,9 +2202,17 @@ class IndexServer:
                 # the server's throttle window (docs/SERVICE.md)
                 "max_inflight": int(self.max_inflight),
                 **self._membership_locked(),
+                # additive: shard servers ride their rank→shard map here
+                # (docs/SHARDING.md); empty for a standalone daemon
+                **self._welcome_extra(),
             }
         self._write_snapshot()
         P.send_msg(sock, P.MSG_WELCOME, welcome)
+
+    def _welcome_extra(self) -> dict:
+        """Extra additive WELCOME fields; ``ShardServer`` overrides to
+        attach its ``shard_map`` + ``shard`` id (docs/SHARDING.md)."""
+        return {}
 
     def _claim_rank_locked(self, want: int, conn_id: int, now: float):
         """Grant ``want`` (or the lowest free rank for -1).  Called under

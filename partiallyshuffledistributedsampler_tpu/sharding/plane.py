@@ -1,0 +1,100 @@
+"""``ShardPlane``: one-call deployment of a sharded serving plane.
+
+The production topology (docs/SHARDING.md "Deployment topology") is N
+``ShardServer`` processes plus one ``ShardRouter``; this helper builds
+the same thing in-process for tests, benchmarks and single-host runs:
+construct the canonical :class:`~.shardmap.ShardMap`, start every shard
+(each optionally paired with a hot standby and given its own
+``wal_dir/<shard_id>/`` + snapshot file), record the bound addresses in
+the shared map, then start the router over it.  ``stop()`` tears down in
+reverse.  The plane object is a context manager, mirroring
+``IndexServer``'s ergonomics.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from .router import ShardRouter
+from .shardmap import ShardMap
+from .shards import ShardServer
+
+
+class ShardPlane:
+    """N shards (+ optional standbys) behind one router (see module doc)."""
+
+    def __init__(self, spec, n_shards: int, *, host: str = "127.0.0.1",
+                 router_port: int = 0, standby: bool = False,
+                 wal_dir: Optional[str] = None,
+                 snapshot_dir: Optional[str] = None,
+                 multi_tenant: bool = False,
+                 server_kwargs: Optional[dict] = None):
+        self.spec = spec
+        self.map = ShardMap.for_world(spec.world, n_shards)
+        self.host, self.router_port = host, int(router_port)
+        self.with_standby = bool(standby)
+        self.wal_dir = wal_dir
+        self.snapshot_dir = snapshot_dir
+        self.multi_tenant = bool(multi_tenant)
+        self.server_kwargs = dict(server_kwargs or {})
+        self.shards: list = []
+        self.standbys: list = []
+        self.router: Optional[ShardRouter] = None
+
+    def _snap(self, name: str) -> Optional[str]:
+        if self.snapshot_dir is None:
+            return None
+        return os.path.join(self.snapshot_dir, name)
+
+    def start(self) -> tuple:
+        """Start shards (+standbys), then the router; returns the router
+        address clients HELLO first."""
+        kw = dict(self.server_kwargs)
+        kw.setdefault("multi_tenant", self.multi_tenant)
+        for sid in range(self.map.n_shards):
+            standby_addr = None
+            if self.with_standby:
+                sb = ShardServer(self.spec, sid, self.map, self.host, 0,
+                                 role="standby",
+                                 snapshot_path=self._snap(
+                                     f"shard-{sid}-standby.json"),
+                                 **kw)
+                sb.start()
+                self.standbys.append(sb)
+                standby_addr = sb.address
+            srv = ShardServer(self.spec, sid, self.map, self.host, 0,
+                              wal_dir=self.wal_dir,
+                              snapshot_path=self._snap(f"shard-{sid}.json"),
+                              standby=standby_addr,
+                              **kw)
+            srv.start()
+            self.shards.append(srv)
+            self.map.set_addr(sid, srv.address)
+        self.router = ShardRouter(
+            self.spec, self.map, self.host, self.router_port,
+            snapshot_path=self._snap("router.json"),
+            multi_tenant=self.multi_tenant)
+        return self.router.start()
+
+    @property
+    def address(self) -> tuple:
+        return self.router.address
+
+    def stop(self) -> None:
+        if self.router is not None:
+            self.router.stop()
+        for srv in self.shards:
+            srv.stop()
+        for sb in self.standbys:
+            sb.stop()
+        self.shards.clear()
+        self.standbys.clear()
+        self.router = None
+
+    def __enter__(self) -> "ShardPlane":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

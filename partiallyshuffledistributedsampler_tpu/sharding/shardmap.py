@@ -1,0 +1,141 @@
+"""The rank→shard map: one static, versioned partition of the rank space.
+
+A sharded serving plane (docs/SHARDING.md) splits a spec's world into N
+contiguous rank slices, one per shared-nothing ``IndexServer`` shard.
+The map is the only piece of global state: it is derived purely from
+``(world, n_shards)``, carries a monotonically increasing ``version``
+(bumped by every cross-shard reshard commit), and a ``fingerprint`` over
+its canonical wire form so a client, a router snapshot, and every shard
+can cheaply agree they hold the same partition.  Shard ``i`` owns ranks
+``[floor(i*W/N), floor((i+1)*W/N))`` — contiguous, so ownership lookup
+is a bisect and slices stay aligned with the spec's blocked partition.
+"""
+
+from __future__ import annotations
+
+import json
+import zlib
+from bisect import bisect_right
+from typing import Optional, Sequence
+
+
+class ShardMap:
+    """Immutable-by-convention rank→shard partition (wire-serializable)."""
+
+    def __init__(self, world: int, slices: Sequence[tuple],
+                 addrs: Optional[Sequence] = None, *, version: int = 1):
+        self.world = int(world)
+        if self.world < 1:
+            raise ValueError(f"world must be >= 1, got {world}")
+        self.version = int(version)
+        self.slices = tuple((int(lo), int(hi)) for lo, hi in slices)
+        if not self.slices:
+            raise ValueError("a shard map needs at least one shard")
+        cursor = 0
+        for sid, (lo, hi) in enumerate(self.slices):
+            if lo != cursor or hi < lo:
+                raise ValueError(
+                    f"shard {sid} slice [{lo}, {hi}) is not a contiguous "
+                    f"cover of the rank space (expected lo={cursor})")
+            cursor = hi
+        if cursor != self.world:
+            raise ValueError(
+                f"slices cover [0, {cursor}) but world is {self.world}")
+        self.addrs = list(addrs) if addrs is not None \
+            else [None] * len(self.slices)
+        if len(self.addrs) != len(self.slices):
+            raise ValueError("one address per shard required")
+        self.addrs = [None if a is None else (str(a[0]), int(a[1]))
+                      for a in self.addrs]
+        #: bisect keys: slice upper bounds (empty slices collapse)
+        self._his = [hi for _, hi in self.slices]
+
+    # ----------------------------------------------------------- derivation
+    @classmethod
+    def for_world(cls, world: int, n_shards: int, *,
+                  version: int = 1) -> "ShardMap":
+        """The canonical contiguous partition of ``world`` ranks over
+        ``n_shards`` shards: shard i owns ``[i*W//N, (i+1)*W//N)``."""
+        world, n = int(world), int(n_shards)
+        if n < 1:
+            raise ValueError(f"n_shards must be >= 1, got {n_shards}")
+        slices = [(i * world // n, (i + 1) * world // n) for i in range(n)]
+        return cls(world, slices, version=version)
+
+    def rebalanced(self, new_world: int) -> "ShardMap":
+        """The post-reshard map: same shard count and addresses, the
+        canonical slices over ``new_world``, ``version + 1``."""
+        m = ShardMap.for_world(new_world, len(self.slices),
+                               version=self.version + 1)
+        m.addrs = list(self.addrs)
+        return m
+
+    # -------------------------------------------------------------- lookup
+    @property
+    def n_shards(self) -> int:
+        return len(self.slices)
+
+    def owner(self, rank: int) -> int:
+        """The shard id owning ``rank`` (contiguous slices → bisect)."""
+        rank = int(rank)
+        if not 0 <= rank < self.world:
+            raise ValueError(f"rank {rank} outside world {self.world}")
+        return bisect_right(self._his, rank)
+
+    def ranks(self, shard_id: int) -> tuple:
+        """The ``[lo, hi)`` slice shard ``shard_id`` owns."""
+        return self.slices[int(shard_id)]
+
+    def owns(self, shard_id: int, rank: int) -> bool:
+        lo, hi = self.slices[int(shard_id)]
+        return lo <= int(rank) < hi
+
+    def addr(self, shard_id: int):
+        return self.addrs[int(shard_id)]
+
+    def set_addr(self, shard_id: int, addr) -> None:
+        """Record where a shard listens (plane startup / failover)."""
+        self.addrs[int(shard_id)] = (str(addr[0]), int(addr[1]))
+
+    # ---------------------------------------------------------------- wire
+    def to_wire(self) -> dict:
+        d = {
+            "version": self.version,
+            "world": self.world,
+            "shards": [
+                {"id": i, "ranks": [lo, hi],
+                 "addr": None if self.addrs[i] is None
+                 else [self.addrs[i][0], self.addrs[i][1]]}
+                for i, (lo, hi) in enumerate(self.slices)
+            ],
+        }
+        d["fingerprint"] = self.fingerprint()
+        return d
+
+    @classmethod
+    def from_wire(cls, d: dict) -> "ShardMap":
+        shards = sorted(d["shards"], key=lambda s: int(s["id"]))
+        return cls(
+            d["world"],
+            [(s["ranks"][0], s["ranks"][1]) for s in shards],
+            [s.get("addr") for s in shards],
+            version=d.get("version", 1),
+        )
+
+    def fingerprint(self) -> str:
+        """Stable hex digest of the canonical map (addresses included —
+        a failover that moves a shard is a different deployment)."""
+        body = json.dumps(
+            {"version": self.version, "world": self.world,
+             "slices": [list(s) for s in self.slices],
+             "addrs": [None if a is None else list(a) for a in self.addrs]},
+            sort_keys=True, separators=(",", ":")).encode()
+        return f"{zlib.crc32(body) & 0xFFFFFFFF:08x}"
+
+    def __eq__(self, other) -> bool:
+        return (isinstance(other, ShardMap)
+                and self.to_wire() == other.to_wire())
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (f"ShardMap(v{self.version}, world={self.world}, "
+                f"slices={list(self.slices)})")

@@ -1,0 +1,197 @@
+"""``ShardServer``: an ``IndexServer`` that owns one slice of the rank space.
+
+A shard is a full :class:`~..service.IndexServer` — same spec (at the
+full world size), same leases/acks/epochs/snapshots/replication/WAL —
+plus the rank-space gate (docs/SHARDING.md): it knows the deployment's
+:class:`~.shardmap.ShardMap` and its own ``shard_id``, refuses a HELLO
+for a rank it does not own with the typed ``wrong_shard`` error
+(carrying ``retry_ms`` and a fresh map so the client re-routes without a
+router round-trip), restricts auto-claim (``rank=-1``) to its own slice,
+and rides ``shard_map`` + ``shard`` in WELCOME.  Durability nests per
+shard: a ``wal_dir`` is suffixed with the shard id, so N shards under
+one base directory never interleave logs.  Cross-shard reshard barriers
+arrive as phased ``RESHARD`` frames from the router (prepare → commit
+with the imposed global barrier, or abort), mapping onto the server's
+two-phase ``_reshard_prepare`` / ``_reshard_commit_prepared`` split; the
+new map is adopted atomically with the cascade commit, and leases for
+ranks the new map moved elsewhere are dropped so their clients re-route.
+"""
+
+from __future__ import annotations
+
+import os
+
+from .. import telemetry
+from ..service import protocol as P
+from ..service.server import IndexServer
+from .shardmap import ShardMap
+
+
+class ShardServer(IndexServer):
+    """One shared-nothing shard of the rank space (see module doc)."""
+
+    _ACCEPT_THREAD_NAME = "psds-shard-accept"
+    _CONN_THREAD_PREFIX = "psds-shard-conn"
+
+    def __init__(self, spec, shard_id: int, shard_map: ShardMap,
+                 host: str = "127.0.0.1", port: int = 0, *,
+                 wal_dir=None, **kw):
+        if wal_dir is not None:
+            # per-shard WAL: N shards under one base dir never interleave
+            wal_dir = os.path.join(str(wal_dir), str(int(shard_id)))
+        super().__init__(spec, host, port, wal_dir=wal_dir, **kw)
+        self.shard_id = int(shard_id)
+        #: the deployment's rank→shard partition; swapped wholesale (an
+        #: atomic reference) at cross-shard commit, read lock-free on
+        #: the HELLO gate
+        self.shard_map = shard_map
+        #: map staged by a phased commit, adopted with the cascade
+        #: commit  # guarded by: self._lock
+        self._pending_map = None
+
+    # --------------------------------------------------------- rank gating
+    def _owned(self) -> tuple:
+        return self.shard_map.ranks(self.shard_id)
+
+    def _wrong_shard_err(self, rank: int) -> dict:
+        m = self.shard_map
+        try:
+            owner = m.owner(rank)
+        except ValueError:
+            owner = None
+        return {
+            "code": "wrong_shard", "retry_ms": 25,
+            "shard": self.shard_id, "owner": owner,
+            "shard_map": m.to_wire(),
+            "detail": f"rank {rank} is not owned by shard {self.shard_id} "
+                      f"(slice {list(self._owned())}, map v{m.version}); "
+                      f"re-route via the attached shard_map",
+        }
+
+    def _on_hello(self, sock, conn_id, header) -> None:
+        want = header.get("rank", -1)
+        want = -1 if want is None else int(want)
+        m = self.shard_map
+        if 0 <= want < m.world and not m.owns(self.shard_id, want):
+            self.metrics.inc("wrong_shard_hellos")
+            P.send_msg(sock, P.MSG_ERROR, self._wrong_shard_err(want))
+            return
+        super()._on_hello(sock, conn_id, header)
+
+    def _claim_rank_locked(self, want: int, conn_id: int, now: float):
+        if want < 0:
+            # auto-claim stays inside this shard's slice: the rest of
+            # the rank space belongs to sibling shards
+            lo, hi = self._owned()
+            for rank in range(lo, min(hi, self.spec.world)):
+                got = super()._claim_rank_locked(rank, conn_id, now)
+                if got is not None:
+                    return got
+            return None
+        return super()._claim_rank_locked(want, conn_id, now)
+
+    def _welcome_extra(self) -> dict:
+        return {"shard": self.shard_id,
+                "shard_map": self.shard_map.to_wire()}
+
+    def _span_extra(self, eng) -> dict:
+        extra = super()._span_extra(eng)
+        extra["shard"] = self.shard_id
+        return extra
+
+    # --------------------------------------------- cross-shard barriers
+    def _on_reshard(self, sock, conn_id, header) -> None:
+        phase = header.get("phase")
+        if phase is None:
+            # a plain RESHARD stays the local single-server barrier
+            super()._on_reshard(sock, conn_id, header)
+            return
+        if phase == "prepare":
+            try:
+                new_world = int(header["world"])
+                if new_world < 1:
+                    raise ValueError(new_world)
+            except (KeyError, TypeError, ValueError):
+                P.send_msg(sock, P.MSG_ERROR,
+                           {"code": "bad_request",
+                            "detail": "RESHARD prepare needs an int "
+                                      "world >= 1"})
+                return
+            rep = self._reshard_prepare(new_world)
+            if rep is None:
+                P.send_msg(sock, P.MSG_ERROR, {
+                    "code": "reshard", "retry_ms": 50,
+                    "detail": "a reshard is already in flight; retry",
+                })
+                return
+            P.send_msg(sock, P.MSG_OK,
+                       {"phase": "prepare", "shard": self.shard_id, **rep})
+            return
+        if phase == "commit":
+            try:
+                barrier = int(header["barrier_units"])
+            except (KeyError, TypeError, ValueError):
+                P.send_msg(sock, P.MSG_ERROR,
+                           {"code": "bad_request",
+                            "detail": "RESHARD commit needs int "
+                                      "barrier_units"})
+                return
+            map_wire = header.get("map")
+            new_map = (ShardMap.from_wire(map_wire)
+                       if map_wire is not None else None)
+            dead = [int(r) for r in (header.get("dead_ranks") or ())]
+            lo, hi = self._owned()
+            participants = range(lo, min(hi, self.spec.world))
+            with self._lock:
+                self._pending_map = new_map
+            ok = self._reshard_commit_prepared(
+                barrier, participants=participants, dead=dead)
+            if not ok:
+                with self._lock:
+                    self._pending_map = None
+                P.send_msg(sock, P.MSG_ERROR, {
+                    "code": "reshard", "retry_ms": 50,
+                    "detail": "no prepared barrier to commit",
+                })
+                return
+            with self._lock:
+                hdr = {"phase": "commit", "shard": self.shard_id,
+                       "generation": self.generation,
+                       "world": self.spec.world,
+                       "committed": self._reshard is None}
+            P.send_msg(sock, P.MSG_OK, hdr)
+            return
+        if phase == "abort":
+            aborted = self._reshard_abort_prepared()
+            with self._lock:
+                self._pending_map = None
+            P.send_msg(sock, P.MSG_OK,
+                       {"phase": "abort", "shard": self.shard_id,
+                        "aborted": bool(aborted)})
+            return
+        P.send_msg(sock, P.MSG_ERROR,
+                   {"code": "bad_request",
+                    "detail": f"unknown RESHARD phase {phase!r}"})
+
+    def _commit_reshard_locked(self) -> bool:
+        committed = super()._commit_reshard_locked()
+        if committed and self._pending_map is not None:
+            # the map flips atomically with the generation bump: before
+            # it, migrating ranks keep draining here; after it, their
+            # HELLOs draw wrong_shard and re-route to the new owner
+            self.shard_map = self._pending_map
+            self._pending_map = None
+            lo, hi = self._owned()
+            for rank in list(self._leases):
+                if not lo <= rank < hi:
+                    self._leases.pop(rank)
+                    self._vacated.pop(rank, None)
+            telemetry.event("shard_map_adopted", shard=self.shard_id,
+                            version=self.shard_map.version)
+        return committed
+
+    def adopt_map(self, shard_map: ShardMap) -> None:
+        """Adopt a newer map outside a barrier (router re-push)."""
+        with self._lock:
+            if shard_map.version >= self.shard_map.version:
+                self.shard_map = shard_map

@@ -27,6 +27,7 @@ _NAMESPACES = (
     "partiallyshuffledistributedsampler_tpu.ops",
     "partiallyshuffledistributedsampler_tpu.ops.cpu",
     "partiallyshuffledistributedsampler_tpu.service",
+    "partiallyshuffledistributedsampler_tpu.sharding",
     "partiallyshuffledistributedsampler_tpu.telemetry",
     "partiallyshuffledistributedsampler_tpu.utils",
 )
@@ -241,4 +242,46 @@ def test_fusion_doc_cross_linked():
 
     res = (DOCS / "RESILIENCE.md").read_text()
     for site in ("client.pipeline", "loader.boundary"):
+        assert site in F.SITES and site in res
+
+
+def test_sharding_doc_cross_linked():
+    """The sharded serving plane is documented where an operator would
+    look: docs/SHARDING.md owns the map/redirect/barrier story (and the
+    make gate + scaling law the smoke's docstring points at), SERVICE.md
+    and ARCHITECTURE.md link to it, API.md documents the four classes,
+    OBSERVABILITY.md the metric names, and RESILIENCE.md the fault sites
+    plus the failure contract rows."""
+    sharding_md = DOCS / "SHARDING.md"
+    assert sharding_md.exists()
+    text = sharding_md.read_text()
+    assert "## Scaling law" in text, (
+        "docs/SHARDING.md lost its Scaling law section — "
+        "benchmarks/sharding_smoke.py's docstring points at it")
+    for token in ("shard_map", "wrong_shard", "fingerprint", "retry_ms",
+                  "dead_ranks", "prepare", "commit",
+                  "sharding-smoke", "ShardPlane"):
+        assert token in text, f"docs/SHARDING.md lost `{token}`"
+    for doc in ("SERVICE.md", "ARCHITECTURE.md", "RESILIENCE.md"):
+        assert "SHARDING.md" in (DOCS / doc).read_text(), (
+            f"docs/{doc} lost its cross-link to docs/SHARDING.md")
+    assert "docs/SHARDING.md" in (DOCS.parent / "README.md").read_text()
+    svc = (DOCS / "SERVICE.md").read_text()
+    assert "## Scale-out sharding" in svc, (
+        "docs/SERVICE.md lost its Scale-out sharding section")
+    api = API_MD.read_text()
+    for token in ("ShardMap", "ShardServer", "ShardRouter", "ShardPlane",
+                  "wrong_shard"):
+        assert token in api, f"docs/API.md lost the sharding surface `{token}`"
+    obs = OBSERVABILITY_MD.read_text()
+    for token in ("router_hellos", "router_redirects", "router_route_ms",
+                  "shard_barriers", "shard_barrier_ms",
+                  "wrong_shard_hellos", "wrong_shard_redirects"):
+        assert token in obs, (
+            f"docs/OBSERVABILITY.md lost the sharding metric `{token}`")
+    # the documented fault sites must be the registered ones
+    from partiallyshuffledistributedsampler_tpu import faults as F
+
+    res = (DOCS / "RESILIENCE.md").read_text()
+    for site in ("router.route", "shard.barrier"):
         assert site in F.SITES and site in res

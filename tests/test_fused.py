@@ -270,3 +270,104 @@ def test_boundary_prefetch_bit_identical(mode):
         for x, y in zip(a, b):
             assert np.array_equal(x, y), (
                 f"boundary prefetch changed the stream at epoch {epoch}")
+
+
+# ---------------------------------------- mid-stream window shrink (clamp)
+class _WindowSpy:
+    """Record the unacked span (``seq - ack``) every pipelined GET_BATCH
+    commits to, split around a caller-flipped marker, so a test can
+    prove the in-flight window both ramped AND later shrank."""
+
+    def __init__(self, monkeypatch, limit_fn=None):
+        self.spans = []          # (span, after_marker, adopted_limit)
+        self.after = False
+        real = P.send_msgs
+
+        def spy(sock, msgs, **kw):
+            lim = None if limit_fn is None else limit_fn()
+            for m, h in msgs:
+                if m == P.MSG_GET_BATCH:
+                    self.spans.append((int(h["seq"]) - int(h["ack"]),
+                                       self.after, lim))
+            return real(sock, msgs, **kw)
+
+        monkeypatch.setattr(P, "send_msgs", spy)
+
+    def split(self):
+        pre = [s for s, after, _ in self.spans if not after]
+        post = [s for s, after, _ in self.spans if after]
+        return pre, post
+
+
+def test_pipelined_window_shrinks_on_midstream_clamp(monkeypatch):
+    """A failover re-HELLO can adopt a SMALLER ``max_inflight`` while the
+    pipelined generator is mid-stream: an already-ramped window must
+    shrink to the new clamp — every request committed after the adoption
+    stays within it (the limit is re-read each iteration, not latched at
+    entry) — and the stream stays bit-identical."""
+    spy = _WindowSpy(monkeypatch)
+    spec = build_spec("plain", 1)
+    with IndexServer(spec) as srv:
+        with ServiceIndexClient(srv.address, rank=0, batch=16,
+                                lookahead=8) as c:
+            got = []
+            for i, arr in enumerate(c.epoch_batches(0)):
+                got.append(arr)
+                if i == 9:
+                    # what a concurrent failover re-HELLO would adopt
+                    # from a peer advertising a smaller window
+                    c._server_max_inflight = 2
+                    spy.after = True
+    pre, post = spy.split()
+    assert max(pre) > 2, "the window never ramped past the later clamp"
+    assert post, "no requests were committed after the clamp shrank"
+    assert max(post) <= 2, (
+        f"a request rode the stale pre-shrink window: spans {post} "
+        "exceed the adopted max_inflight=2")
+    assert np.array_equal(np.concatenate(got),
+                          np.asarray(spec.rank_indices(0, 0)))
+
+
+def test_failover_to_smaller_window_peer_never_overruns(monkeypatch):
+    """The end-to-end contract behind the clamp: a ramped ``lookahead=8``
+    client hard-loses its ``max_inflight=8`` primary and finishes on a
+    ``max_inflight=2`` standby — the standby must never see an unacked
+    span beyond its own advertisement (zero throttle refusals) and the
+    stream stays bit-identical."""
+    holder = {}
+    spy = _WindowSpy(monkeypatch,
+                     limit_fn=lambda: holder["c"]._server_max_inflight)
+    spec = build_spec("plain", 1)
+    standby = IndexServer(spec, role="standby", repl_feed_timeout=0.25,
+                          max_inflight=2)
+    standby.start()
+    primary = IndexServer(spec, standby=standby.address,
+                          repl_feed_timeout=0.25, max_inflight=8)
+    primary.start()
+    c = ServiceIndexClient(primary.address, rank=0, batch=16, lookahead=8,
+                           backoff_base=0.01, reconnect_timeout=5.0)
+    holder["c"] = c
+    try:
+        got = []
+        for i, arr in enumerate(c.epoch_batches(0)):
+            got.append(arr)
+            if i == 9:
+                wait_synced(primary, standby)
+                primary.kill()
+                spy.after = True
+        counters = c.metrics.report()["counters"]
+    finally:
+        c.close()
+        primary.kill()
+        standby.stop()
+    assert c._server_max_inflight == 2, "the standby's clamp never adopted"
+    # spans committed to the dead primary's socket before the client saw
+    # the reset never reach the standby; the contract binds every send
+    # made AFTER the re-HELLO adopted the standby's advertisement
+    post = [s for s, _, lim in spy.spans if lim == 2]
+    assert post and max(post) <= 2, (
+        f"the standby saw an unacked span beyond its window: {post}")
+    assert counters.get("throttled", 0) == 0
+    assert counters.get("failovers", 0) >= 1
+    assert np.array_equal(np.concatenate(got),
+                          np.asarray(spec.rank_indices(0, 0)))
