@@ -58,6 +58,12 @@
 #                          shard failover, cross-shard reshard barrier,
 #                          router restart, then the p99-flat-across-
 #                          shards bar under the client sweep
+#   * capability smoke     tests/test_capability.py (`-m capability`)
+#                          + benchmarks/capability_smoke.py — signed
+#                          epoch capabilities: token laws, on-device
+#                          regen bit-identity in every spec mode incl.
+#                          mid-epoch reshard and failover, then the
+#                          served-vs-capability >=100x wire-bytes bar
 #   * analyze              project-native static analysis (docs/ANALYSIS.md):
 #                          guarded-by discipline, fault-site/protocol/
 #                          metrics-docs drift, clock discipline, silent-
@@ -71,7 +77,8 @@ PY ?= python
 
 .PHONY: check test bench native dryrun service-smoke chaos-smoke \
 	elastic-smoke telemetry-smoke failover-smoke tenancy-smoke \
-	durability-smoke fused-smoke sharding-smoke analyze analysis-smoke
+	durability-smoke fused-smoke sharding-smoke capability-smoke \
+	analyze analysis-smoke
 
 # the driver parses the LAST line of bench.py's combined output (round 3
 # lost its headline to the details line — BENCH_r03.json "parsed": null),
@@ -157,6 +164,15 @@ fused-smoke:
 sharding-smoke:
 	$(PY) -m pytest tests/test_sharding.py -q -m sharding -ra
 	$(PY) benchmarks/sharding_smoke.py
+
+# capability gate (docs/CAPABILITY.md): the signed-epoch-capability
+# suite (token sign/verify laws, on-device regen bit-identity across
+# all spec modes, mid-epoch reshard union law, failover, tenant
+# isolation, idle heartbeat cadence), then the served-vs-capability
+# wire-bytes smoke (>=100x reduction, streams bit-identical)
+capability-smoke:
+	$(PY) -m pytest tests/test_capability.py -q -m capability -ra
+	$(PY) benchmarks/capability_smoke.py
 
 # static-analysis gate (docs/ANALYSIS.md): every lint pass over the
 # package + docs; any finding is a non-zero exit with file:line output

@@ -464,6 +464,21 @@ def main() -> None:
         except Exception as exc:
             details["sharding_error"] = repr(exc)[:200]
 
+    # detail tier: capability — served-batch vs signed-capability wire
+    # bytes for one epoch: the capability arm regenerates on-device and
+    # must move >=100x fewer bytes with a bit-identical stream
+    # (methodology in benchmarks/capability_smoke.py)
+    if not smoke:
+        try:
+            sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+            from benchmarks.capability_smoke import (
+                summarize as capability_summarize,
+            )
+
+            details["capability"] = capability_summarize()
+        except Exception as exc:
+            details["capability_error"] = repr(exc)[:200]
+
     # detail tier: analysis — concurrency-sanitizer overhead: the
     # tracked-lock arm must stay within the raw-lock arm's rep noise
     # and record zero lock-order cycles (methodology in

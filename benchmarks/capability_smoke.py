@@ -1,0 +1,160 @@
+"""Capability-mode wire smoke: serving seeds must crush serving indices.
+
+Two consumers:
+
+* ``make capability-smoke`` / ``python benchmarks/capability_smoke.py``
+  — the CI gate: stream the same epoch through two arms on fresh
+  daemons sharing one deployment secret — served batches
+  (``epoch_batches``) vs a signed epoch capability regenerated locally
+  (``capability_epoch_batches``) — assert the two streams bit-identical
+  and the capability arm moving at least ``_MIN_REDUCTION_X`` (100×)
+  fewer wire bytes.  Exit 0 and one JSON line on success; raises loudly
+  otherwise.
+
+* ``bench.py`` imports :func:`summarize` for ``details["capability"]``.
+
+Methodology: wire bytes are counted by wrapping ``protocol.pack`` —
+the single choke point every frame (both directions, both peers) is
+encoded through, resolved as a module global at call time so the wrap
+sees coalesced pipelined sends too.  Each arm runs against its own
+fresh ``IndexServer`` so neither warms the other's epoch cache; the
+byte ratio is a *structural* claim (O(samples) payloads vs O(1)
+grants + heartbeats — docs/CAPABILITY.md), so unlike the timing bars
+elsewhere it needs no noise floor: the bar is a hard 100×.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_SECRET = b"psds-capability-smoke-secret"
+
+#: the acceptance bar: capability mode must move at least this many
+#: times fewer wire bytes than the served-batch path for one epoch
+_MIN_REDUCTION_X = 100.0
+
+
+class _PackMeter:
+    """Count every framed byte by wrapping ``protocol.pack`` in place."""
+
+    def __init__(self):
+        from partiallyshuffledistributedsampler_tpu.service import (
+            protocol as P,
+        )
+
+        self._P = P
+        self._orig = P.pack
+        self.bytes = 0
+        self.frames = 0
+
+    def __enter__(self):
+        orig = self._orig
+
+        def counting_pack(msg_type, header, payload=b""):
+            frame = orig(msg_type, header, payload)
+            self.bytes += len(frame)
+            self.frames += 1
+            return frame
+
+        self._P.pack = counting_pack
+        return self
+
+    def __exit__(self, *exc):
+        self._P.pack = self._orig
+        return False
+
+
+def _served_arm(spec, epoch: int, batch: int):
+    from partiallyshuffledistributedsampler_tpu.service import (
+        IndexServer,
+        ServiceIndexClient,
+    )
+
+    with IndexServer(spec, capability_secret=_SECRET) as srv:
+        with _PackMeter() as meter:
+            t0 = time.perf_counter()
+            with ServiceIndexClient(srv.address, rank=0, batch=batch) as c:
+                got = np.concatenate(list(c.epoch_batches(epoch)))
+            wall_ms = (time.perf_counter() - t0) * 1e3
+    return got, meter, wall_ms
+
+
+def _capability_arm(spec, epoch: int, batch: int):
+    from partiallyshuffledistributedsampler_tpu.service import (
+        IndexServer,
+        ServiceIndexClient,
+    )
+
+    with IndexServer(spec, capability_secret=_SECRET) as srv:
+        with _PackMeter() as meter:
+            t0 = time.perf_counter()
+            with ServiceIndexClient(srv.address, rank=0, batch=batch,
+                                    capability_secret=_SECRET) as c:
+                got = np.concatenate(list(
+                    c.capability_epoch_batches(epoch, spec=spec)))
+            wall_ms = (time.perf_counter() - t0) * 1e3
+        report = srv.metrics.report()
+    return got, meter, wall_ms, report
+
+
+def summarize(*, n: int = None, window: int = 512,
+              batch: int = 4096, epoch: int = 1) -> dict:
+    """Served-batch vs capability wire bytes for one epoch — the
+    ``details["capability"]`` tier."""
+    from partiallyshuffledistributedsampler_tpu.service import (
+        PartialShuffleSpec,
+    )
+
+    if n is None:
+        n = 100_000 if os.environ.get("PSDS_BENCH_SMOKE") else 1_000_000
+    spec = PartialShuffleSpec.plain(n, window=window, seed=0, world=1)
+    ref = np.asarray(spec.rank_indices(epoch, 0))
+
+    served, served_meter, served_ms = _served_arm(spec, epoch, batch)
+    cap, cap_meter, cap_ms, report = _capability_arm(spec, epoch, batch)
+
+    if not np.array_equal(served, ref):
+        raise AssertionError("served-batch stream diverged from the spec")
+    if not np.array_equal(cap, ref):
+        raise AssertionError(
+            "capability stream diverged from the served stream — "
+            "regeneration must be bit-identical (docs/CAPABILITY.md)")
+    issued = int(report["counters"].get("capabilities_issued", 0))
+    if issued < 1:
+        raise AssertionError(
+            f"capability arm served without issuing a grant: {report!r}")
+
+    reduction = served_meter.bytes / max(1, cap_meter.bytes)
+    return {
+        "n": n, "batch": batch,
+        "served_wire_bytes": served_meter.bytes,
+        "served_frames": served_meter.frames,
+        "served_wall_ms": round(served_ms, 3),
+        "capability_wire_bytes": cap_meter.bytes,
+        "capability_frames": cap_meter.frames,
+        "capability_wall_ms": round(cap_ms, 3),
+        "capabilities_issued": issued,
+        "bytes_reduction_x": round(float(reduction), 1),
+        "meets_100x": bool(reduction >= _MIN_REDUCTION_X),
+    }
+
+
+def main() -> None:
+    """The `make capability-smoke` gate: hard assertions, one JSON line."""
+    report = summarize()
+    assert report["meets_100x"], (
+        f"capability mode moved only "
+        f"{report['bytes_reduction_x']}x fewer wire bytes "
+        f"(bar: {_MIN_REDUCTION_X}x): {report!r}")
+    print(json.dumps({"capability_smoke": "ok", **report}))
+
+
+if __name__ == "__main__":
+    main()

@@ -71,7 +71,9 @@ _DOC_TOKEN_PASSTHROUGH = frozenset({
     # "counters, timers, per-client")
     "spec_fingerprint", "retry_ms", "grace_ms", "from_lsn",
     # typed error codes documented next to the counters they bump
-    "tenant_admission", "spec_mismatch",
+    "tenant_admission", "spec_mismatch", "capability_unsupported",
+    # capability-mode kwarg/helper/wire vocabulary (docs/CAPABILITY.md)
+    "capability_heartbeat_s", "membership_stream", "target_samples",
     # smoke-report fields the docs quote next to the metric tables
     "steady_noise_ms_per_step", "sanitize_overhead_within_noise",
 })

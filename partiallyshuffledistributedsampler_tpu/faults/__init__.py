@@ -18,7 +18,9 @@ Vocabulary:
   ``client.pipeline`` (the pipelined client topping up its lookahead
   window), ``loader.prefetch`` (one step of the gather thread),
   ``loader.regen`` (local epoch index generation), ``loader.boundary``
-  (the epoch-boundary prefetch worker).
+  (the epoch-boundary prefetch worker), ``capability.issue`` /
+  ``capability.verify`` (the daemon signing, and a client admitting, a
+  signed epoch capability — docs/CAPABILITY.md).
 * A **fault kind** is what happens when a rule fires (:data:`KINDS`):
   ``reset`` (connection reset), ``delay`` (sleep ``delay_s``),
   ``torn_frame`` (half a frame hits the wire, then reset), ``corrupt``

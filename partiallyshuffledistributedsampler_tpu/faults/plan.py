@@ -38,6 +38,8 @@ SITES = frozenset({
     "loader.prefetch",       # one step of HostDataLoader's gather thread
     "loader.regen",          # local epoch index generation
     "loader.boundary",       # the epoch-boundary prefetch worker fetching
+    "capability.issue",      # the daemon signing an epoch capability grant
+    "capability.verify",     # a client verifying a received capability
 })
 
 #: what a firing rule does (interpreted by runtime.perform / the sites)

@@ -131,6 +131,17 @@ class HostDataLoader:
         fetch (a cheap ``heartbeat`` generation probe decides).  Costs
         one extra epoch index array held across the boundary; False
         restores strictly-serial boundaries.
+    capability_mode: serve seeds, not indices (docs/CAPABILITY.md).  On
+        the service path, fetch one signed epoch capability per epoch
+        and regenerate the index stream on-device instead of streaming
+        index batches over the wire — O(1) wire bytes per rank per
+        epoch, bit-identical by the shared regen law.  Requires the
+        ``index_client`` to be constructed with the deployment's
+        ``capability_secret``.  A refused or unverifiable capability
+        (no secret on either side, bad signature, fingerprint mismatch)
+        falls back to the served-batch path FOR THAT EPOCH with a loud
+        warning — the fallback ladder is capability → served batches →
+        degraded local regen.
 
     The sampler kwargs (shuffle/drop_last/order_windows/partition/rounds)
     pass through to the index core unchanged.
@@ -158,6 +169,7 @@ class HostDataLoader:
         reattach_interval: float = 5.0,
         stall_timeout: Optional[float] = 30.0,
         boundary_prefetch: bool = True,
+        capability_mode: bool = False,
         **kwargs,
     ) -> None:
         if mixture is not None and shard_sizes is not None:
@@ -274,6 +286,7 @@ class HostDataLoader:
         self.kwargs = kwargs
         self.num_samples = num_samples
         self.index_client = index_client
+        self.capability_mode = bool(capability_mode)
         self.degraded_fallback = bool(degraded_fallback)
         self.reattach_interval = float(reattach_interval)
         self.stall_timeout = (
@@ -532,6 +545,7 @@ class HostDataLoader:
         return self._served_indices_impl(epoch, NULL_SPAN)
 
     def _served_indices_impl(self, epoch: int, sp) -> np.ndarray:
+        from ..capability import CapabilityError
         from ..service.client import FencedError, ServiceUnavailable
 
         client = self.index_client
@@ -546,6 +560,22 @@ class HostDataLoader:
             client.metrics.inc("reattached", self.rank)
             sp.event("reattached")
         try:
+            if self.capability_mode:
+                try:
+                    return np.asarray(client.capability_epoch_indices(
+                        epoch, spec=self.stream_spec))
+                except CapabilityError as exc:
+                    # fallback ladder (docs/CAPABILITY.md): a refused or
+                    # unverifiable capability drops to the served-batch
+                    # path for THIS epoch — loudly, never silently
+                    warnings.warn(
+                        f"capability path refused for epoch {epoch} "
+                        f"({exc}); falling back to served batches",
+                        RuntimeWarning,
+                        stacklevel=3,
+                    )
+                    sp.event("capability_fallback", error=str(exc))
+                    client.metrics.inc("capability_fallbacks", self.rank)
             return np.asarray(client.epoch_indices(epoch))
         except (ServiceUnavailable, FencedError) as exc:
             # FencedError means every reachable peer lost a promotion

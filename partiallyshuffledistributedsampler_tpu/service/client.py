@@ -53,6 +53,17 @@ reconnect or failover lands back in the same namespace), a refused
 attach surfaces as the typed :class:`SpecMismatchError` carrying both
 fingerprints, and a ``tenant_admission`` refusal (per-tenant quota) is
 retried like throttle backpressure using the server's ``retry_ms``.
+
+Capability mode (docs/CAPABILITY.md "Serve seeds, not indices"): when
+both sides share a ``capability_secret``, ``capability_epoch_batches``
+streams the epoch with ZERO index bytes on the wire — the client fetches
+one signed :class:`~..capability.EpochCapability`, verifies it
+(signature, fingerprint, tenant, generation, epoch), regenerates its
+stream on-device with the same kernels the degraded fallback uses, and
+reports only ack watermarks over periodic heartbeats.  Exactly-once
+cursors, elastic drain barriers, and failover replay all keep working
+because issuance creates the rank's epoch cursor server-side and the
+heartbeat acks drive it exactly as batch requests would.
 """
 
 from __future__ import annotations
@@ -65,6 +76,13 @@ from typing import Iterator, Optional
 import numpy as np
 
 from .. import faults as F
+from ..capability import (
+    CapabilityError,
+    EpochCapability,
+    membership_stream,
+    orphan_slice,
+    replay_trail,
+)
 from ..telemetry import enabled as _tel_enabled, span as _span
 from ..utils.retry import RetryPolicy
 from . import protocol as P
@@ -183,6 +201,15 @@ class ServiceIndexClient:
                  by the server's WELCOME-advertised ``max_inflight`` so
                  pipelining never trips the throttle gate; ``1``
                  restores the strictly request-reply serve path.
+    capability_secret: per-deployment HMAC key for verifying signed
+                 epoch capabilities (docs/CAPABILITY.md); ``None``
+                 disables ``capability_epoch_batches``.
+    capability_heartbeat_s: keepalive cadence for capability-mode
+                 (batchless) streams — a HEARTBEAT carrying the
+                 delivered-ack cursor goes out at least this often, so
+                 lease eviction and lazy drain commits behave
+                 identically with and without batch flow.
+    clock:       injectable monotonic clock for that cadence (tests).
     """
 
     def __init__(
@@ -199,6 +226,9 @@ class ServiceIndexClient:
         metrics: Optional[ServiceMetrics] = None,
         retry_policy: Optional[RetryPolicy] = None,
         lookahead: int = 4,
+        capability_secret=None,
+        capability_heartbeat_s: float = 1.0,
+        clock=None,
     ) -> None:
         self.address = _parse_address(address)
         self.rank = None if rank is None else int(rank)
@@ -224,6 +254,20 @@ class ServiceIndexClient:
         self.lookahead = int(lookahead)
         if self.lookahead < 1:
             raise ValueError(f"lookahead must be >= 1, got {lookahead}")
+        #: per-deployment HMAC key for verifying signed epoch
+        #: capabilities (docs/CAPABILITY.md); None disables the
+        #: capability-mode stream entirely
+        self.capability_secret = capability_secret
+        self.capability_heartbeat_s = float(capability_heartbeat_s)
+        self._clock = clock if clock is not None else time.monotonic
+        #: the latest HEARTBEAT/CAPABILITY reply's drain notice for this
+        #: capability-mode rank: ``{"epoch", "target_samples"}`` while a
+        #: barrier drains, else None (docs/CAPABILITY.md "Drain law")
+        self._cap_drain: Optional[dict] = None
+        #: resume point from the latest grant: the slot's server-side
+        #: acked cursor + 1, in seq units — a takeover of a partly-
+        #: served slot regenerates from here, never from seq 0
+        self._cap_resume_seq = 0
         #: the server's throttle window, adopted from WELCOME (additive
         #: field); bounds the pipelined lookahead so a full window of
         #: un-acked requests is never refused as out-of-window
@@ -688,6 +732,15 @@ class ServiceIndexClient:
                         raise ServiceError(code, rheader.get("detail", ""),
                                            rheader)
                     continue
+                if code == "capability_issue":
+                    # transient issuance refusal (an injected fault, or
+                    # a daemon mid-hiccup): GET_CAPABILITY is idempotent
+                    # — pace by the server's hint and replay
+                    retry_s = float(rheader.get("retry_ms", 50)) / 1e3
+                    if not op.pause(min_delay=retry_s):
+                        raise ServiceError(code, rheader.get("detail", ""),
+                                           rheader)
+                    continue
                 if code in ("router_route", "shard_barrier"):
                     # transient control-plane trouble (an injected route
                     # fault, or a cross-shard barrier fan-out that did
@@ -1130,6 +1183,10 @@ class ServiceIndexClient:
         _, rheader, _ = self._rpc(P.MSG_HEARTBEAT, header)
         if "hb" in header:
             self._pending_hb = None
+        # capability-mode drain discovery: while a barrier drains, the
+        # reply names this rank's drain watermark (additive field;
+        # served-batch clients never see it)
+        self._cap_drain = rheader.get("cap_drain")
         return int(rheader.get("generation", self.generation))
 
     def _queue_trail_ack(self, epoch: int) -> None:
@@ -1199,6 +1256,227 @@ class ServiceIndexClient:
         _, rheader, _ = self._rpc(P.MSG_RESHARD, {"world": int(new_world)})
         return rheader
 
+    # ---------------------------------------------------------- capability
+    def _fetch_capability(self, epoch: int, spec) -> EpochCapability:
+        """Obtain and verify the signed epoch capability for ``epoch``.
+
+        ``capability_stale`` is the revocation surface: the typed
+        retryable error already carries the FRESH membership and
+        capability, so adopting them here costs no second round trip.
+        ``capability_unsupported`` (a daemon running without a signing
+        secret) surfaces as :class:`CapabilityError` — the loader's
+        fallback ladder drops to the served-batch path on it
+        (docs/CAPABILITY.md "Fallback ladder")."""
+        req = {"rank": self.rank, "epoch": int(epoch),
+               "gen": self.generation}
+        try:
+            reply, rheader, _ = self._rpc(P.MSG_GET_CAPABILITY, req)
+        except ServiceError as exc:
+            if exc.code == "capability_stale":
+                self.metrics.inc("capability_stale", self.rank)
+                self._adopt_membership(exc.header)
+                wire = exc.header.get("capability")
+                if wire is None:
+                    raise CapabilityError(
+                        "capability_stale reply carried no fresh "
+                        "capability") from exc
+                cap = EpochCapability.from_wire(wire)
+                rheader = exc.header
+            elif exc.code == "capability_unsupported":
+                raise CapabilityError(
+                    exc.header.get("detail")
+                    or "server does not issue capabilities") from exc
+            else:
+                raise
+        else:
+            if reply != P.MSG_CAPABILITY:
+                raise P.ProtocolError(
+                    f"expected CAPABILITY, got {P.msg_name(reply)}")
+            self._adopt_membership(rheader)
+            cap = EpochCapability.from_wire(rheader["capability"])
+        ts = rheader.get("target_samples")
+        if ts is not None:
+            # issued mid-drain: the reply names our drain watermark
+            self._cap_drain = {"epoch": int(epoch),
+                               "target_samples": int(ts)}
+        # the slot's server-side acked cursor: a takeover of a
+        # partly-served slot resumes regeneration AFTER it (the
+        # capability-mode half of the double-delivery guard)
+        self._cap_resume_seq = int(rheader.get("ack", -1)) + 1
+        self._verify_capability(cap, int(epoch), spec)
+        return cap
+
+    def _verify_capability(self, cap: EpochCapability, epoch: int,
+                           spec) -> None:
+        """Client-side admission of a received capability: signature,
+        spec fingerprint, tenant scope, epoch, generation.  ANY failure
+        is a loud :class:`CapabilityError` (counted in
+        ``capability_rejects``), never a silently-different stream."""
+        rule = F.draw("capability.verify")
+        if rule is not None:
+            if rule.kind == "corrupt":
+                # deterministic tamper: the HMAC check below must refuse
+                cap = cap.tampered()
+            else:
+                try:
+                    F.perform(rule)
+                except F.InjectedThreadDeath:
+                    raise
+                except Exception as exc:
+                    self.metrics.inc("capability_rejects", self.rank)
+                    raise CapabilityError(
+                        f"capability verification failed ({exc!r})"
+                    ) from exc
+        problem = None
+        if self.capability_secret is None:
+            problem = "client has no capability_secret to verify with"
+        elif not cap.verify(self.capability_secret):
+            problem = "HMAC signature check failed"
+        elif spec is not None and \
+                cap.fingerprint != spec.fingerprint(include_world=False):
+            problem = (f"fingerprint {cap.fingerprint!r} is not this "
+                       "job's spec")
+        elif cap.tenant != self.tenant:
+            problem = (f"grant is scoped to tenant {cap.tenant!r}, "
+                       f"this client is bound to {self.tenant!r}")
+        elif int(cap.epoch) != int(epoch):
+            problem = f"grant is for epoch {cap.epoch}, not {epoch}"
+        elif int(cap.generation) != int(self.generation):
+            problem = (f"grant names generation {cap.generation}; the "
+                       f"adopted membership is {self.generation}")
+        if problem is not None:
+            self.metrics.inc("capability_rejects", self.rank)
+            raise CapabilityError(f"capability refused: {problem}")
+
+    def capability_epoch_batches(self, epoch: int, *, spec=None,
+                                 start_seq: int = 0
+                                 ) -> Iterator[np.ndarray]:
+        """Stream ``epoch``'s batches with ZERO index bytes on the wire
+        (docs/CAPABILITY.md).
+
+        One GET_CAPABILITY fetches the signed grant; after verification
+        the stream is regenerated on-device with the same shared-law
+        kernels the degraded fallback uses
+        (:func:`~..capability.regen.membership_stream`), bit-identical
+        to what ``epoch_batches`` would have served.  Only ack
+        watermarks go back — flushed as HEARTBEATs whenever the locally
+        delivered span would exceed the server's ``max_inflight`` window
+        (the issuance slack floor covers exactly that span, so an
+        elastic barrier can never freeze BEHIND what we delivered) and
+        at least every ``capability_heartbeat_s`` as the batchless
+        keepalive.
+
+        Rides through reshards like the served path: a heartbeat that
+        returns a bumped generation (or a ``cap_drain`` drain notice)
+        makes the generator deliver exactly to the frozen watermark,
+        flush the gate-satisfying ack, re-fetch through the
+        ``capability_stale`` flow, and continue with the post-reshard
+        remainder — one contiguous exactly-once stream.  Ends early
+        (``membership_lost``) only when the shrunken world has no slot
+        for this rank."""
+        spec = spec if spec is not None else self.expected_spec
+        if spec is None:
+            raise CapabilityError(
+                "capability mode needs the stream-shaping spec: pass "
+                "spec= here or construct the client with one")
+        epoch, seq = int(epoch), int(start_seq)
+        if self._samples_epoch != epoch:
+            # new epoch: the trail describes the previous epoch's
+            # deliveries — start fresh (same law as epoch_batches)
+            self._trail = []
+            self._epoch_samples = 0
+            self._samples_epoch = epoch
+        self._cursor = {"epoch": epoch, "seq": seq}
+        self._ensure_connected()
+        cap = self._fetch_capability(epoch, spec)
+        # a partly-served slot (takeover of a vacated rank) resumes
+        # after the server-side acked watermark the grant reported
+        seq = max(seq, self._cap_resume_seq)
+        self._cursor = {"epoch": epoch, "seq": seq}
+        acked = seq - 1              # watermark last flushed server-side
+        last_hb = self._clock()
+        while True:                  # one iteration per membership
+            if not (self.rank is not None and self.world is not None
+                    and int(self.rank) < int(self.world)):
+                # shrunk out: our share of the epoch belongs to others
+                self.metrics.inc("membership_lost")
+                return
+            mi = self._server_max_inflight or self.lookahead
+            layers = self.layers if (
+                self.elastic_epoch is not None
+                and int(self.elastic_epoch) == epoch) else []
+            arr = membership_stream(spec, epoch, self.rank, self.world,
+                                    layers, self.orphans)
+            total = int(arr.shape[0])
+            refetch = False
+            while not refetch:
+                cd = self._cap_drain
+                target = None
+                if cd is not None and int(cd.get("epoch", -1)) == epoch:
+                    target = int(cd["target_samples"])
+                stop = total if target is None else min(total, target)
+                lo = seq * self.batch
+                if lo >= stop:
+                    # delivered everything this membership owes — the
+                    # epoch tail, or the frozen drain watermark.  Flush
+                    # the terminal ack NOW (a lazy piggyback could
+                    # deadlock a barrier gated on it), then finish or
+                    # wait out the commit.
+                    g = self.heartbeat()
+                    acked, last_hb = seq - 1, self._clock()
+                    if int(g) == int(cap.generation) \
+                            and self.generation == cap.generation:
+                        if target is None:
+                            return
+                        # drain-wait: the barrier needs other ranks too
+                        time.sleep(min(0.05, self.backoff_base))
+                        continue
+                    cap = self._fetch_capability(epoch, spec)
+                    seq = self._cap_resume_seq
+                    acked = seq - 1
+                    self._cursor = {"epoch": epoch, "seq": seq}
+                    refetch = True
+                    continue
+                if seq - acked > mi or (self._clock() - last_hb
+                                        >= self.capability_heartbeat_s):
+                    # client half of the slack law / batchless keepalive
+                    g = self.heartbeat()
+                    acked, last_hb = seq - 1, self._clock()
+                    if int(g) != int(cap.generation) \
+                            or self.generation != cap.generation:
+                        # revoked mid-stream; by the slack law our
+                        # delivered watermark is <= the frozen target,
+                        # so the trail entry the stale flow records is
+                        # exactly the prefix the cascade preserved
+                        cap = self._fetch_capability(epoch, spec)
+                        seq = self._cap_resume_seq
+                        acked = seq - 1
+                        self._cursor = {"epoch": epoch, "seq": seq}
+                        refetch = True
+                    # re-enter the loop either way: the reply may have
+                    # carried a ``cap_drain`` notice, and delivering the
+                    # next batch against the pre-heartbeat ``stop``
+                    # would run past a freshly frozen drain watermark
+                    continue
+                hi = min(lo + self.batch, stop)
+                batch_arr = arr[lo:hi]
+                # advance BEFORE yielding: once the consumer holds the
+                # batch it counts as delivered (exactly-once on resume)
+                seq += 1
+                self._cursor = {"epoch": epoch, "seq": seq}
+                self._epoch_samples = max(self._epoch_samples, int(hi))
+                yield batch_arr
+
+    def capability_epoch_indices(self, epoch: int, *,
+                                 spec=None) -> np.ndarray:
+        """The rank's full epoch stream via the capability path — the
+        drop-in for ``epoch_indices`` when both sides share a
+        ``capability_secret``."""
+        parts = list(self.capability_epoch_batches(epoch, spec=spec))
+        if not parts:
+            return np.empty(0, dtype=np.int64)
+        return np.concatenate(parts)
+
     def local_epoch_indices(self, spec, epoch: int) -> np.ndarray:
         """Compose this client's epoch stream LOCALLY from its adopted
         membership — the degraded-mode fallback's source of truth.
@@ -1211,49 +1489,26 @@ class ServiceIndexClient:
         contributes its full remainder stream — together bit-identical
         to what the service would have gone on to serve.  ``spec`` is
         the stream-shaping spec (any world; each membership entry
-        re-bases it via ``with_world``)."""
+        re-bases it via ``with_world``).
+
+        The composition law itself lives in
+        :func:`~..capability.regen.replay_trail` — ONE implementation
+        shared with capability-mode regeneration, so the two local
+        paths cannot drift."""
         epoch = int(epoch)
-
-        def stream(rank, world, layers, orphans):
-            if rank is None or world is None or rank >= int(world):
-                return np.empty(0, dtype=np.int64)
-            s = spec.with_world(int(world))
-            arr = np.asarray(s.rank_indices(
-                epoch, int(rank),
-                layers=[tuple(map(int, l)) for l in layers] or None,
-            ))
-            if rank == 0 and orphans:
-                pre = [self._orphan_slice(spec, o) for o in orphans
-                       if int(o["epoch"]) == epoch]
-                if pre:
-                    arr = np.concatenate(pre + [arr])
-            return arr
-
-        if self.elastic_epoch != epoch:
-            # no cascade applies to this epoch: one plain stream (the
-            # orphan filter drops other epochs' descriptors)
-            return stream(self.rank, self.world, [], self.orphans)
-        parts = []
-        if self._samples_epoch == epoch:
-            for m in self._trail:
-                parts.append(stream(m["rank"], m["world"], m["layers"],
-                                    m["orphans"])[: int(m["samples"])])
-        parts.append(stream(self.rank, self.world, self.layers,
-                            self.orphans))
-        parts = [p for p in parts if len(p)]
-        if not parts:
-            return np.empty(0, dtype=np.int64)
-        return np.concatenate(parts)
+        return replay_trail(
+            spec, epoch, rank=self.rank, world=self.world,
+            layers=self.layers, orphans=self.orphans,
+            elastic_epoch=self.elastic_epoch,
+            trail=self._trail if self._samples_epoch == epoch else (),
+        )
 
     @staticmethod
     def _orphan_slice(spec, o: dict) -> np.ndarray:
         """Materialise one orphan descriptor against ``spec`` — the same
-        law the server applies when serving rank 0's prefix."""
-        layers = [tuple(map(int, l)) for l in o.get("layers", [])] or None
-        s = spec.with_world(int(o["world"]))
-        arr = np.asarray(s.rank_indices(int(o["epoch"]), int(o["rank"]),
-                                        layers=layers))
-        return arr[int(o["lo"]):int(o["hi"])]
+        law the server applies when serving rank 0's prefix (delegates
+        to the shared :func:`~..capability.regen.orphan_slice`)."""
+        return orphan_slice(spec, o)
 
     # ---------------------------------------------------------- checkpoint
     def state_dict(self) -> dict:

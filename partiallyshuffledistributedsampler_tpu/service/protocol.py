@@ -102,6 +102,36 @@ client re-routes without a router round-trip), ``router_route`` (an
 injected route fault; retryable), ``shard_barrier`` (a cross-shard
 fan-out did not complete; retryable — barrier requests are idempotent).
 
+Capability frames (docs/CAPABILITY.md — serve seeds, not indices):
+
+    GET_CAPABILITY → CAPABILITY | ERROR   a signed epoch capability: the
+                                          world-stripped spec fingerprint,
+                                          epoch seed, membership generation
+                                          + cascade ``layers``, tenant, and
+                                          an HMAC over the canonical
+                                          encoding.  The reply carries the
+                                          current membership, the slot's
+                                          server-side ``ack`` cursor (a
+                                          takeover of a partly-served
+                                          slot resumes regeneration at
+                                          ``ack + 1``, never seq 0) and,
+                                          when a drain barrier is already
+                                          in flight for the rank, its
+                                          ``target_samples`` clamp.
+
+A capability-mode client sends only ``HEARTBEAT`` frames with the
+``hb=[epoch, ack]`` piggyback while it regenerates indices on-device;
+the ``OK`` reply MAY carry ``cap_drain={"epoch", "target_samples"}`` to
+tell a batchless stream its drain clamp (an additive header field;
+served-batch clients never see it).  Error codes: ``capability_stale``
+(retryable — the request named a revoked generation; the header carries
+a fresh ``capability`` plus the new membership to adopt),
+``capability_issue`` (retryable — an injected/transient issuance fault),
+``capability_unsupported`` (terminal — the daemon has no signing secret
+configured; use the served-batch path).  Both frame types are additive
+within protocol version 2: a deployment that never requests a
+capability puts zero extra bytes on the wire.
+
 Tracing: any request header MAY carry ``trace=[trace_id, span_id]`` —
 the sender's open span context (docs/OBSERVABILITY.md).  Receivers that
 know about it parent their dispatch span under it; receivers that don't
@@ -151,6 +181,10 @@ MSG_TRACE_REPORT = 16
 MSG_REPL_SYNC = 17
 MSG_REPL_APPEND = 18
 MSG_REPL_PROMOTE = 19
+# additive-within-v2: signed epoch capabilities (docs/CAPABILITY.md) —
+# a client that never sends GET_CAPABILITY pays zero protocol overhead
+MSG_GET_CAPABILITY = 20
+MSG_CAPABILITY = 21
 
 _NAMES = {
     v: k[len("MSG_"):] for k, v in list(globals().items())

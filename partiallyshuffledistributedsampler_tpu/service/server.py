@@ -102,6 +102,7 @@ import numpy as np
 from .. import faults as F
 from ..analysis.lockorder import new_lock
 from .. import telemetry
+from ..capability import EpochCapability
 from ..durability import FsyncPolicy, WriteAheadLog
 from ..durability.recover import replay_wal_tail
 from ..telemetry import annotate as _annotate, span as _span
@@ -125,7 +126,7 @@ SNAPSHOT_KIND = "index_service"
 #: REPL_* feed are exempt)
 _MUTATING_MSGS = frozenset({
     P.MSG_HELLO, P.MSG_GET_BATCH, P.MSG_SET_EPOCH, P.MSG_HEARTBEAT,
-    P.MSG_LEAVE, P.MSG_RESHARD,
+    P.MSG_LEAVE, P.MSG_RESHARD, P.MSG_GET_CAPABILITY,
 })
 
 
@@ -179,6 +180,7 @@ class IndexServer(DispatchListener):
         regen_scheduler: Optional[FairShareScheduler] = None,
         wal_dir: Optional[str] = None,
         fsync: str = "group_commit",
+        capability_secret=None,
     ) -> None:
         if max_inflight < 1:
             raise ValueError(f"max_inflight must be >= 1, got {max_inflight}")
@@ -228,6 +230,15 @@ class IndexServer(DispatchListener):
         self._orphans: list[dict] = []  # guarded by: self._lock
         #: in-flight reshard (phase 'freeze' → 'drain'), None otherwise
         self._reshard: Optional[dict] = None  # guarded by: self._lock
+        #: per-deployment HMAC key for signed epoch capabilities
+        #: (docs/CAPABILITY.md); None keeps GET_CAPABILITY refused and
+        #: the wire format byte-identical to a pre-capability daemon
+        self.capability_secret = capability_secret
+        #: rank -> {"epoch", "gen", "total"} issued-capability records:
+        #: which ranks consume via on-device regen (their ack-only
+        #: cursors carry the consumption slack), replicated/persisted so
+        #: a promoted standby keeps honoring the grants
+        self._cap_records: dict[int, dict] = {}  # guarded by: self._lock
         #: rank -> clock time its lease went vacant (membership_timeout)
         self._vacated: dict[int, float] = {}  # guarded by: self._lock
         self._listener: Optional[socket.socket] = None
@@ -481,6 +492,7 @@ class IndexServer(DispatchListener):
             clock=self._clock,
             role=self.role,
             regen_scheduler=self._regen_sched,
+            capability_secret=self.capability_secret,
         )
         eng.quota = q
         eng._parent = self
@@ -610,6 +622,11 @@ class IndexServer(DispatchListener):
             # replicate — every lease is vacant on the peer
             "leases": {str(r): int(l.get("batch") or 0)
                        for r, l in self._leases.items()},
+            # issued-capability records (additive within format 2): a
+            # restarted/promoted daemon keeps honoring outstanding
+            # grants' ack-only cursors (docs/CAPABILITY.md)
+            "capabilities": {str(r): dict(rec)
+                             for r, rec in self._cap_records.items()},
         }
         if self._wal is not None and self._repl_log is not None:
             # the WAL position this snapshot reflects — recovery
@@ -753,6 +770,11 @@ class IndexServer(DispatchListener):
             ee = state.get("elastic_epoch")
             self.elastic_epoch = None if ee is None else int(ee)
             self._orphans = [dict(o) for o in state.get("orphans") or []]
+            self._cap_records = {
+                int(r): {"epoch": int(c["epoch"]), "gen": int(c["gen"]),
+                         "total": int(c["total"])}
+                for r, c in (state.get("capabilities") or {}).items()
+            }
             if theirs.world != self.spec.world:
                 self.spec = self.spec.with_world(theirs.world)
             rs = state.get("reshard")
@@ -979,6 +1001,11 @@ class IndexServer(DispatchListener):
         ee = state.get("elastic_epoch")
         self.elastic_epoch = None if ee is None else int(ee)
         self._orphans = [dict(o) for o in state.get("orphans") or []]
+        self._cap_records = {
+            int(r): {"epoch": int(c["epoch"]), "gen": int(c["gen"]),
+                     "total": int(c["total"])}
+            for r, c in (state.get("capabilities") or {}).items()
+        }
         self._cursors = {
             int(r): {"epoch": int(c["epoch"]), "acked": int(c["acked"]),
                      "hi": int(c["hi"]),
@@ -1048,6 +1075,15 @@ class IndexServer(DispatchListener):
             # state (spec wire included) so failover restores it
             self._apply_tenant_state_locked(
                 str(rec.get("tenant")), dict(rec.get("state") or {}))
+        elif op == "capability":
+            # an issued-capability grant: the mirror must keep applying
+            # the consumption slack to this rank's ack-only cursor, or
+            # a promoted standby would commit barriers below what the
+            # capability client locally delivered (docs/CAPABILITY.md)
+            self._cap_records[int(rec["rank"])] = {
+                "epoch": int(rec["epoch"]), "gen": int(rec["gen"]),
+                "total": int(rec["total"]),
+            }
         # unknown ops fall through: the record vocabulary is additive
 
     def _on_repl_sync(self, sock, header) -> None:
@@ -1380,6 +1416,8 @@ class IndexServer(DispatchListener):
             engine._on_set_epoch(sock, header)
         elif msg == P.MSG_HEARTBEAT:
             engine._on_heartbeat(sock, conn_id, header)
+        elif msg == P.MSG_GET_CAPABILITY:
+            engine._on_get_capability(sock, conn_id, header)
         elif msg == P.MSG_SNAPSHOT:
             engine._write_snapshot(force=True)
             P.send_msg(sock, P.MSG_SNAPSHOT_STATE,
@@ -1432,6 +1470,19 @@ class IndexServer(DispatchListener):
         if cur is None or cur["epoch"] != int(epoch):
             return False
         cur["acked"] = max(cur["acked"], int(ack))
+        rec = self._cap_records.get(rank)
+        if (rec is not None and int(rec["epoch"]) == int(epoch)
+                and int(rec["gen"]) == self.generation):
+            # capability-mode rank: no batches flow, so the served-
+            # samples watermark an elastic barrier cuts on is maintained
+            # from the acks, with a slack of ``max_inflight`` batches —
+            # the client never locally delivers further past its last
+            # flushed ack (docs/CAPABILITY.md "Drain law"), so the
+            # barrier C covers every sample it may have consumed
+            b = int(lease.get("batch") or 0)
+            slack = min((cur["acked"] + 1 + self.max_inflight) * b,
+                        int(rec["total"]))
+            cur["samples"] = max(int(cur.get("samples", 0)), slack)
         self._repl_append("cursor", rank=rank, **cur)
         rs = self._reshard
         if (rs is not None and rs.get("phase") == "drain"
@@ -1494,9 +1545,166 @@ class IndexServer(DispatchListener):
                     committed = self._ack_advance_locked(
                         rank, lease, epoch, ack)
             gen = self.generation
+            reply = {"generation": gen}
+            rs = self._reshard
+            rec = (self._cap_records.get(int(rank))
+                   if rank is not None else None)
+            if (rec is not None and rs is not None
+                    and rs.get("phase") == "drain"
+                    and int(rank) in rs["targets"]
+                    and int(rec["epoch"]) == int(rs["epoch"])):
+                # a batchless capability stream discovers its drain
+                # clamp here (served-batch clients get it from the
+                # GET_BATCH clamp instead): additive field, absent
+                # outside a drain (docs/CAPABILITY.md "Drain law")
+                reply["cap_drain"] = {
+                    "epoch": int(rs["epoch"]),
+                    "target_samples": int(rs["targets"][int(rank)]),
+                }
         if committed:
             self._write_snapshot(force=True)
-        P.send_msg(sock, P.MSG_OK, {"generation": gen})
+        P.send_msg(sock, P.MSG_OK, reply)
+
+    # ----------------------------------------------------------- capability
+    def _capability_locked(self, epoch: int) -> EpochCapability:
+        """The signed grant for the CURRENT membership — one HMAC over
+        the canonical encoding (docs/CAPABILITY.md).  Under
+        ``self._lock``."""
+        return EpochCapability(
+            fingerprint=self.spec.fingerprint(include_world=False),
+            epoch=int(epoch),
+            seed=int(self.spec.seed),
+            generation=int(self.generation),
+            world=int(self.spec.world),
+            layers=tuple((int(w), int(c)) for w, c in self.layers),
+            elastic_epoch=self.elastic_epoch,
+            orphans=tuple(dict(o) for o in self._orphans),
+            tenant=self.tenant_id,
+        ).signed(self.capability_secret)
+
+    def _on_get_capability(self, sock, conn_id, header) -> None:
+        """Issue a signed epoch capability (docs/CAPABILITY.md): the
+        client regenerates its indices on-device and reports only ack
+        watermarks, so issuance must create the rank's epoch cursor (an
+        ack against a missing cursor is dropped by
+        :meth:`_ack_advance_locked`, which would stall drain barriers)
+        and persist an issued-capability record so a restarted or
+        promoted daemon keeps honoring the grant."""
+        try:
+            rank = int(header["rank"])
+            epoch = int(header["epoch"])
+        except (KeyError, TypeError, ValueError):
+            P.send_msg(sock, P.MSG_ERROR,
+                       {"code": "bad_request",
+                        "detail": "GET_CAPABILITY needs rank/epoch ints"})
+            return
+        if self.capability_secret is None:
+            # terminal by design: an unsigned grant would let any client
+            # forge membership, so a secretless daemon only serves the
+            # batch path — and puts zero capability bytes on the wire
+            _annotate(error_code="capability_unsupported")
+            P.send_msg(sock, P.MSG_ERROR, {
+                "code": "capability_unsupported",
+                "detail": "this daemon has no capability_secret "
+                          "configured; use the served-batch path",
+            })
+            return
+        try:
+            F.fire("capability.issue")
+        except F.InjectedThreadDeath:
+            raise
+        except Exception as exc:
+            self.metrics.inc("capability_rejects", rank)
+            _annotate(error_code="capability_issue")
+            P.send_msg(sock, P.MSG_ERROR, {
+                "code": "capability_issue", "retry_ms": 50,
+                "detail": f"capability issuance refused ({exc!r}); retry",
+            })
+            return
+        t0 = time.perf_counter()
+        with self._lock:
+            lease = self._leases.get(rank)
+            if lease is None or lease.get("owner") != conn_id:
+                P.send_msg(sock, P.MSG_ERROR, {
+                    "code": "not_owner",
+                    "detail": f"rank {rank} is not leased to this "
+                              "connection; HELLO first",
+                })
+                return
+            self._touch(rank, lease)
+            rs = self._reshard
+            if rs is not None and rs.get("phase") == "freeze":
+                # a grant issued mid-freeze could outrun the watermark
+                # snapshot the freeze took; refuse like GET_BATCH does
+                _annotate(error_code="reshard")
+                P.send_msg(sock, P.MSG_ERROR, {
+                    "code": "reshard", "retry_ms": 20,
+                    "detail": "reshard barrier is freezing; retry shortly",
+                })
+                return
+            cur_gen = self.generation
+        # the rank's total (rank 0's orphan prefix included) anchors the
+        # consumption slack; _rank_array takes self._lock, so this MUST
+        # stay outside it
+        total = int(self._rank_array(epoch, rank).shape[0])
+        with self._lock:
+            if self.generation != cur_gen:
+                # a sweep committed a barrier while we computed: the
+                # retry is issued against the fresh membership
+                _annotate(error_code="reshard")
+                P.send_msg(sock, P.MSG_ERROR, {
+                    "code": "reshard", "retry_ms": 20,
+                    "detail": "reshard committed mid-issuance; retry",
+                })
+                return
+            cur = self._cursors.get(rank)
+            if cur is None or cur["epoch"] != epoch:
+                cur = self._cursors[rank] = {"epoch": epoch, "acked": -1,
+                                             "hi": -1, "samples": 0}
+            batch = int(lease.get("batch") or 0)
+            # consumption floor: the client may locally deliver up to
+            # max_inflight batches before its first ack flush, and a
+            # barrier freezing in that window must still cover them
+            floor = min((cur["acked"] + 1 + self.max_inflight) * batch,
+                        total)
+            cur["samples"] = max(int(cur.get("samples", 0)), floor)
+            rec = {"epoch": epoch, "gen": cur_gen, "total": total}
+            self._cap_records[rank] = rec
+            self._repl_append("capability", rank=rank, **rec)
+            self._repl_append("cursor", rank=rank, **cur)
+            # the slot's acked cursor rides every grant: a new lease
+            # holder adopting a partly-served slot (a vacated rank
+            # mid-drain, a takeover after a client death) must resume
+            # regeneration at acked+1, not replay from seq 0 — the
+            # capability-mode half of the double-delivery guard
+            hdr = {"capability": self._capability_locked(epoch).to_wire(),
+                   "ack": int(cur["acked"]),
+                   **self._membership_locked()}
+            rs = self._reshard
+            if (rs is not None and rs.get("phase") == "drain"
+                    and epoch == rs["epoch"] and rank in rs["targets"]):
+                hdr["target_samples"] = int(rs["targets"][rank])
+            stale = (header.get("gen") is not None
+                     and int(header["gen"]) != cur_gen)
+        self.metrics.registry.histogram("capability_issue_ms").observe(
+            (time.perf_counter() - t0) * 1e3)
+        if stale:
+            # revocation surface: the request named a revoked
+            # generation — the typed retryable error carries the FRESH
+            # capability, so adopting and resuming costs no second trip
+            self.metrics.inc("capability_stale", rank)
+            _annotate(error_code="capability_stale")
+            P.send_msg(sock, P.MSG_ERROR, {
+                "code": "capability_stale", "retry_ms": 20,
+                "detail": f"generation {header.get('gen')} was revoked "
+                          f"(now at {cur_gen}); adopt the attached "
+                          "membership and capability",
+                **hdr,
+            })
+            return
+        self.metrics.inc("capabilities_issued", rank)
+        self._write_snapshot()
+        P.send_msg(sock, P.MSG_CAPABILITY, hdr)
 
     # ------------------------------------------------- elastic membership
     def _membership_locked(self) -> dict:
@@ -1856,6 +2064,10 @@ class IndexServer(DispatchListener):
         self._orphans = new_orphans
         self._cursors = {}
         self._vacated = {}
+        # revocation: every outstanding capability named the committed-
+        # away generation; clients re-fetch through ``capability_stale``
+        # and issuance re-populates (and re-replicates) the records
+        self._cap_records = {}
         now = self._clock()
         for rank in list(self._leases):
             if rank >= self.spec.world:

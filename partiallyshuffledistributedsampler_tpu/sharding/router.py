@@ -215,8 +215,11 @@ class ShardRouter(DispatchListener):
             return
         if msg == P.MSG_HELLO:
             self._on_hello(sock, header)
-        elif msg in (P.MSG_GET_BATCH, P.MSG_HEARTBEAT, P.MSG_LEAVE):
-            # the router is never on the data path: redirect
+        elif msg in (P.MSG_GET_BATCH, P.MSG_HEARTBEAT, P.MSG_LEAVE,
+                     P.MSG_GET_CAPABILITY):
+            # the router is never on the data path — capability
+            # issuance included (the owning shard signs and revokes
+            # its own grants; the router stays placement-only): redirect
             self.metrics.inc("router_redirects")
             P.send_msg(sock, P.MSG_ERROR, self._wrong_shard_err(
                 header.get("rank")))

@@ -78,6 +78,20 @@ class ShardServer(IndexServer):
             return
         super()._on_hello(sock, conn_id, header)
 
+    def _on_get_capability(self, sock, conn_id, header) -> None:
+        # capabilities are issued by the OWNING shard only — the grant
+        # names the membership generation this shard's barrier protocol
+        # revokes, so a sibling must not sign for a rank it cannot
+        # revoke for (docs/CAPABILITY.md, docs/SHARDING.md)
+        want = header.get("rank", -1)
+        want = -1 if want is None else int(want)
+        m = self.shard_map
+        if 0 <= want < m.world and not m.owns(self.shard_id, want):
+            self.metrics.inc("wrong_shard_hellos")
+            P.send_msg(sock, P.MSG_ERROR, self._wrong_shard_err(want))
+            return
+        super()._on_get_capability(sock, conn_id, header)
+
     def _claim_rank_locked(self, want: int, conn_id: int, now: float):
         if want < 0:
             # auto-claim stays inside this shard's slice: the rest of

@@ -28,6 +28,7 @@ _NAMESPACES = (
     "partiallyshuffledistributedsampler_tpu.ops.cpu",
     "partiallyshuffledistributedsampler_tpu.service",
     "partiallyshuffledistributedsampler_tpu.sharding",
+    "partiallyshuffledistributedsampler_tpu.capability",
     "partiallyshuffledistributedsampler_tpu.telemetry",
     "partiallyshuffledistributedsampler_tpu.utils",
 )
@@ -242,6 +243,50 @@ def test_fusion_doc_cross_linked():
 
     res = (DOCS / "RESILIENCE.md").read_text()
     for site in ("client.pipeline", "loader.boundary"):
+        assert site in F.SITES and site in res
+
+
+def test_capability_doc_cross_linked():
+    """Capability mode is documented where an operator would look:
+    docs/CAPABILITY.md owns the token/slack/drain/fallback story (and
+    the make gate), SERVICE.md carries the protocol frames and a
+    section pointing at it, API.md documents the knobs on all three
+    surfaces, OBSERVABILITY.md the metric names, and RESILIENCE.md the
+    fault sites plus the failure-contract rows."""
+    cap_md = DOCS / "CAPABILITY.md"
+    assert cap_md.exists()
+    text = cap_md.read_text()
+    for token in ("EpochCapability", "HMAC", "GET_CAPABILITY",
+                  "capability_stale", "capability_unsupported",
+                  "capability_secret", "cap_drain", "target_samples",
+                  "membership_stream", "replay_trail", "ack + 1",
+                  "capability-smoke", "Fallback ladder"):
+        assert token in text, f"docs/CAPABILITY.md lost `{token}`"
+    for doc in ("SERVICE.md", "RESILIENCE.md", "SHARDING.md", "API.md"):
+        assert "CAPABILITY.md" in (DOCS / doc).read_text(), (
+            f"docs/{doc} lost its cross-link to docs/CAPABILITY.md")
+    assert "docs/CAPABILITY.md" in (DOCS.parent / "README.md").read_text()
+    svc = (DOCS / "SERVICE.md").read_text()
+    assert "## Capability mode" in svc, (
+        "docs/SERVICE.md lost its Capability mode section")
+    for token in ("GET_CAPABILITY", "CAPABILITY"):
+        assert token in svc, f"docs/SERVICE.md lost the `{token}` frame"
+    api = API_MD.read_text()
+    for token in ("capability_secret=None", "capability_heartbeat_s=1.0",
+                  "capability_mode=False", "EpochCapability",
+                  "membership_stream", "replay_trail", "CapabilityError"):
+        assert token in api, f"docs/API.md lost the capability surface `{token}`"
+    obs = OBSERVABILITY_MD.read_text()
+    for token in ("capabilities_issued", "capability_rejects",
+                  "capability_stale", "capability_fallbacks",
+                  "capability_issue_ms"):
+        assert token in obs, (
+            f"docs/OBSERVABILITY.md lost the capability metric `{token}`")
+    # the documented fault sites must be the registered ones
+    from partiallyshuffledistributedsampler_tpu import faults as F
+
+    res = (DOCS / "RESILIENCE.md").read_text()
+    for site in ("capability.issue", "capability.verify"):
         assert site in F.SITES and site in res
 
 
