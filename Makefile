@@ -64,6 +64,12 @@
 #                          regen bit-identity in every spec mode incl.
 #                          mid-epoch reshard and failover, then the
 #                          served-vs-capability >=100x wire-bytes bar
+#   * streaming smoke      tests/test_streaming.py (`-m streaming`)
+#                          + benchmarks/streaming_smoke.py — epochless
+#                          moving-horizon shuffle: append-while-serve
+#                          exactly-once, online re-weighting, bounded
+#                          WAL state, advance-barrier failover, then
+#                          the streaming-within-frozen-noise bar
 #   * analyze              project-native static analysis (docs/ANALYSIS.md):
 #                          guarded-by discipline, fault-site/protocol/
 #                          metrics-docs drift, clock discipline, silent-
@@ -78,7 +84,7 @@ PY ?= python
 .PHONY: check test bench native dryrun service-smoke chaos-smoke \
 	elastic-smoke telemetry-smoke failover-smoke tenancy-smoke \
 	durability-smoke fused-smoke sharding-smoke capability-smoke \
-	analyze analysis-smoke
+	streaming-smoke analyze analysis-smoke
 
 # the driver parses the LAST line of bench.py's combined output (round 3
 # lost its headline to the details line — BENCH_r03.json "parsed": null),
@@ -173,6 +179,16 @@ sharding-smoke:
 capability-smoke:
 	$(PY) -m pytest tests/test_capability.py -q -m capability -ra
 	$(PY) benchmarks/capability_smoke.py
+
+# streaming gate (docs/STREAMING.md): the epochless moving-horizon
+# suite (spec laws, append-while-serve exactly-once, online mixture
+# re-weighting with capability bit-identity, mid-stream reshard,
+# watermark GC bounded state, crash recovery, advance-barrier
+# failover, chaos append/advance faults), then the append-while-serve
+# vs frozen-dataset noise bar and the advance-latency bar
+streaming-smoke:
+	$(PY) -m pytest tests/test_streaming.py -q -m streaming -ra
+	$(PY) benchmarks/streaming_smoke.py
 
 # static-analysis gate (docs/ANALYSIS.md): every lint pass over the
 # package + docs; any finding is a non-zero exit with file:line output

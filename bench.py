@@ -479,6 +479,22 @@ def main() -> None:
         except Exception as exc:
             details["capability_error"] = repr(exc)[:200]
 
+    # detail tier: streaming — append-while-serve vs frozen-dataset
+    # wall per horizon (the epochless gate/append/advance bookkeeping
+    # must disappear into the frozen arm's own rep noise) plus the
+    # horizon-advance latency bar (methodology in
+    # benchmarks/streaming_smoke.py)
+    if not smoke:
+        try:
+            sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+            from benchmarks.streaming_smoke import (
+                summarize as streaming_summarize,
+            )
+
+            details["streaming"] = streaming_summarize()
+        except Exception as exc:
+            details["streaming_error"] = repr(exc)[:200]
+
     # detail tier: analysis — concurrency-sanitizer overhead: the
     # tracked-lock arm must stay within the raw-lock arm's rep noise
     # and record zero lock-order cycles (methodology in

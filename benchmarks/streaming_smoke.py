@@ -1,0 +1,183 @@
+"""Streaming smoke: epochless serving must cost what frozen serving costs.
+
+Two consumers:
+
+* ``make streaming-smoke`` / ``python benchmarks/streaming_smoke.py``
+  — the CI gate: serve the same number of samples through two arms on
+  fresh daemons — a frozen dataset consumed as ordinary epochs
+  (``epoch_batches``) vs a moving-horizon stream whose samples are
+  APPENDED while ranks are consuming (``stream_batches``) — assert the
+  streamed union is every appended sample exactly once and the
+  streaming arm's per-horizon wall within the frozen arm's own
+  rep-to-rep noise.  Exit 0 and one JSON line on success; raises loudly
+  otherwise.
+
+* ``bench.py`` imports :func:`summarize` for ``details["streaming"]``.
+
+Methodology: both arms serve ``HORIZONS`` blocks of ``HORIZON`` samples
+with one rank and the same batch, each against its own fresh
+``IndexServer``.  The frozen arm's per-epoch walls give the noise band
+(max - min); the streaming arm must land within it above the median —
+the moving-horizon gate, the append bookkeeping and the advance
+barrier all ride the steady-state serve path, so any structural
+regression surfaces as a wall gap, not a unit-test failure
+(docs/STREAMING.md "Bounded state").  The horizon-advance latency bar
+comes from the daemon's own ``horizon_advance_ms`` histogram: each
+advance is a lightweight freeze→advance→resume (plus one forced
+checkpoint seal), NOT a reshard, so its p50 must stay under
+``_MAX_ADVANCE_P50_MS``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from collections import Counter
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+#: the advance barrier is a lightweight generation bump + checkpoint
+#: seal; a p50 above this means it grew reshard-shaped machinery
+_MAX_ADVANCE_P50_MS = 250.0
+
+
+def _frozen_arm(horizon: int, horizons: int, window: int, batch: int):
+    """Per-epoch walls serving ``horizons`` frozen epochs of H samples."""
+    from partiallyshuffledistributedsampler_tpu.service import (
+        IndexServer,
+        PartialShuffleSpec,
+        ServiceIndexClient,
+    )
+
+    spec = PartialShuffleSpec.plain(horizon, window=window, seed=0, world=1)
+    walls = []
+    with IndexServer(spec) as srv:
+        with ServiceIndexClient(srv.address, rank=0, batch=batch,
+                                backoff_base=0.01,
+                                reconnect_timeout=30.0) as c:
+            for e in range(horizons):
+                t0 = time.perf_counter()
+                n = sum(len(b) for b in c.epoch_batches(e))
+                walls.append((time.perf_counter() - t0) * 1e3)
+                assert n == horizon, (e, n)
+    return walls
+
+
+def _streaming_arm(horizon: int, horizons: int, window: int, batch: int):
+    """Wall + union + advance stats for the append-while-serve arm."""
+    from partiallyshuffledistributedsampler_tpu.service import (
+        IndexServer,
+        ServiceIndexClient,
+    )
+    from partiallyshuffledistributedsampler_tpu.streaming import StreamSpec
+
+    spec = StreamSpec.plain_stream(horizon, window=window, seed=0, world=1)
+    with IndexServer(spec) as srv:
+        stop = threading.Event()
+
+        def feeder():
+            c = ServiceIndexClient(srv.address, rank=None, batch=batch,
+                                   attach=True, backoff_base=0.01,
+                                   reconnect_timeout=30.0)
+            try:
+                # one horizon ahead of the serve loop: appends land
+                # mid-serve but never starve it
+                for _ in range(horizons):
+                    c.append(horizon)
+                    time.sleep(0.001)
+            finally:
+                stop.set()
+                c.close()
+
+        ft = threading.Thread(target=feeder)
+        ft.start()
+        got = []
+        t0 = time.perf_counter()
+        with ServiceIndexClient(srv.address, rank=0, batch=batch,
+                                backoff_base=0.01,
+                                reconnect_timeout=30.0) as c:
+            for arr in c.stream_batches(horizons=horizons):
+                got.append(np.asarray(arr))
+        wall_ms = (time.perf_counter() - t0) * 1e3
+        ft.join(30)
+        assert stop.is_set(), "feeder hung"
+        report = srv.metrics.report()
+        final_epoch = int(srv.epoch)
+    union = Counter(np.concatenate(got).tolist())
+    if union != Counter(range(horizons * horizon)):
+        raise AssertionError(
+            "streamed union is not every appended sample exactly once — "
+            "the moving-horizon law broke (docs/STREAMING.md)")
+    if final_epoch != horizons - 1:
+        raise AssertionError(
+            f"stream ended at horizon {final_epoch}, "
+            f"expected {horizons - 1}")
+    return wall_ms, report
+
+
+def summarize(*, horizon: int = None, horizons: int = 6,
+              window: int = 64, batch: int = 256) -> dict:
+    """Frozen-epoch vs append-while-serve wall per horizon — the
+    ``details["streaming"]`` tier."""
+    if horizon is None:
+        horizon = (4096 if os.environ.get("PSDS_BENCH_SMOKE") else 16384)
+
+    frozen_walls = _frozen_arm(horizon, horizons, window, batch)
+    stream_wall, report = _streaming_arm(horizon, horizons, window, batch)
+
+    # first-epoch compile/regen warmup belongs to both arms equally;
+    # the noise band is the frozen arm's own rep spread past warmup
+    frozen = sorted(frozen_walls[1:])
+    frozen_med = frozen[len(frozen) // 2]
+    noise = max(frozen) - min(frozen)
+    stream_per_h = stream_wall / horizons
+
+    counters = report["counters"]
+    hists = report["histograms"]
+    advances = int(counters.get("horizon_advances", 0))
+    if advances != horizons - 1:
+        raise AssertionError(
+            f"{advances} advances for {horizons} horizons: the barrier "
+            "double-fired or never fired")
+    adv = hists.get("horizon_advance_ms", {})
+    within = bool(stream_per_h <= frozen_med + max(noise, 0.5))
+    return {
+        "horizon": horizon, "horizons": horizons, "batch": batch,
+        "frozen_wall_ms_per_epoch": round(frozen_med, 3),
+        "frozen_noise_ms": round(noise, 3),
+        "streaming_wall_ms_per_horizon": round(stream_per_h, 3),
+        "stream_appends": int(counters.get("stream_appends", 0)),
+        "horizon_advances": advances,
+        "gc_truncations": int(counters.get("stream_gc_truncations", 0)),
+        "advance_p50_ms": float(adv.get("p50_ms", 0.0)),
+        "advance_max_ms": float(adv.get("max_ms", 0.0)),
+        "append_visible_p50_ms": float(
+            hists.get("append_visible_ms", {}).get("p50_ms", 0.0)),
+        "advance_under_bar": bool(
+            adv.get("p50_ms", 0.0) <= _MAX_ADVANCE_P50_MS),
+        "streaming_within_noise": within,
+    }
+
+
+def main() -> None:
+    """The `make streaming-smoke` gate: hard assertions, one JSON line."""
+    report = summarize()
+    assert report["streaming_within_noise"], (
+        f"append-while-serve wall "
+        f"{report['streaming_wall_ms_per_horizon']}ms/horizon fell out of "
+        f"the frozen arm's noise ({report['frozen_wall_ms_per_epoch']}ms "
+        f"± {report['frozen_noise_ms']}ms): {report!r}")
+    assert report["advance_under_bar"], (
+        f"horizon advance p50 {report['advance_p50_ms']}ms exceeds "
+        f"{_MAX_ADVANCE_P50_MS}ms — the barrier grew reshard-shaped "
+        f"machinery: {report!r}")
+    print(json.dumps({"streaming_smoke": "ok", **report}))
+
+
+if __name__ == "__main__":
+    main()

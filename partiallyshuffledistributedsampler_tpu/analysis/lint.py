@@ -72,6 +72,9 @@ _DOC_TOKEN_PASSTHROUGH = frozenset({
     "spec_fingerprint", "retry_ms", "grace_ms", "from_lsn",
     # typed error codes documented next to the counters they bump
     "tenant_admission", "spec_mismatch", "capability_unsupported",
+    "horizon_pending", "horizon_advance", "stream_append",
+    # streaming-mode kwarg/helper/wire vocabulary (docs/STREAMING.md)
+    "capability_stream_batches", "stream_seq", "weights_delta",
     # capability-mode kwarg/helper/wire vocabulary (docs/CAPABILITY.md)
     "capability_heartbeat_s", "membership_stream", "target_samples",
     # smoke-report fields the docs quote next to the metric tables

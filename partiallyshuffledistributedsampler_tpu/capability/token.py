@@ -63,12 +63,18 @@ class EpochCapability:
     elastic_epoch: Optional[int] = None
     orphans: tuple = ()
     tenant: Optional[str] = None
+    #: moving-horizon streams only (docs/STREAMING.md): the horizon's
+    #: effective mixture weights, signed into the grant so on-device
+    #: regen folds a re-weighted horizon bit-identically; None on frozen
+    #: datasets and plain-base streams (and absent from the canonical
+    #: encoding then, so pre-streaming capabilities verify unchanged)
+    stream_weights: Optional[tuple] = None
     sig: str = ""
 
     # ------------------------------------------------------------- encoding
     def body(self) -> dict:
         """The signed fields — everything except the signature itself."""
-        return {
+        out = {
             "fingerprint": str(self.fingerprint),
             "epoch": int(self.epoch),
             "seed": int(self.seed),
@@ -80,6 +86,12 @@ class EpochCapability:
             "orphans": [dict(o) for o in self.orphans],
             "tenant": self.tenant,
         }
+        if self.stream_weights is not None:
+            # additive: only present on mixture-base streams, keeping
+            # every pre-streaming capability's canonical bytes (and
+            # therefore its signature) byte-identical
+            out["stream_weights"] = [int(x) for x in self.stream_weights]
+        return out
 
     def canonical(self) -> bytes:
         """The canonical signing encoding: sorted-key compact JSON of
@@ -131,6 +143,9 @@ class EpochCapability:
                                else int(wire["elastic_epoch"])),
                 orphans=tuple(dict(o) for o in (wire.get("orphans") or ())),
                 tenant=wire.get("tenant"),
+                stream_weights=(
+                    None if wire.get("stream_weights") is None
+                    else tuple(int(x) for x in wire["stream_weights"])),
                 sig=str(wire.get("sig", "")),
             )
         except (KeyError, TypeError, ValueError) as exc:

@@ -40,6 +40,8 @@ SITES = frozenset({
     "loader.boundary",       # the epoch-boundary prefetch worker fetching
     "capability.issue",      # the daemon signing an epoch capability grant
     "capability.verify",     # a client verifying a received capability
+    "stream.append",         # a feeder APPEND extending the index space
+    "stream.advance",        # the ack-gated horizon-advance barrier
 })
 
 #: what a firing rule does (interpreted by runtime.perform / the sites)

@@ -143,6 +143,20 @@ class HostDataLoader:
         warning — the fallback ladder is capability → served batches →
         degraded local regen.
 
+    streaming: epochless moving-horizon mode (docs/STREAMING.md).  The
+        loader's stream description becomes a ``StreamSpec`` over
+        ``horizon`` samples per generation (plain or mixture base), and
+        ``epoch(g)`` serves horizon GENERATION ``g`` — absolute
+        append-only indices for the plain base, global source ids for
+        the mixture base.  A horizon-generation bump is treated as an
+        epoch boundary by every cache (the one-entry index cache and
+        the boundary prefetch box), so no stale-horizon indices survive
+        an advance.  On the service path the daemon's eligibility gate
+        and advance barrier pace the fetches; ``data`` must cover every
+        appended sample.
+    horizon: samples per horizon generation (required with
+        ``streaming=True``, invalid otherwise).
+
     The sampler kwargs (shuffle/drop_last/order_windows/partition/rounds)
     pass through to the index core unchanged.
     """
@@ -170,12 +184,32 @@ class HostDataLoader:
         stall_timeout: Optional[float] = 30.0,
         boundary_prefetch: bool = True,
         capability_mode: bool = False,
+        streaming: bool = False,
+        horizon: Optional[int] = None,
         **kwargs,
     ) -> None:
         if mixture is not None and shard_sizes is not None:
             raise ValueError(
                 "mixture and shard_sizes are mutually exclusive streams"
             )
+        self.streaming = bool(streaming)
+        self.horizon = None if horizon is None else int(horizon)
+        if self.streaming:
+            if self.horizon is None or self.horizon < 1:
+                raise ValueError(
+                    "streaming=True needs horizon (samples per horizon "
+                    "generation, docs/STREAMING.md)"
+                )
+            if shard_sizes is not None:
+                raise ValueError(
+                    "shard-mode streams are frozen-dataset only; "
+                    "streaming rides the plain or mixture base"
+                )
+            if mixture is not None and epoch_samples is None:
+                # each horizon is one mixture epoch of H samples
+                epoch_samples = self.horizon
+        elif horizon is not None:
+            raise ValueError("horizon applies to streaming loaders only")
         self.mixture = mixture
         self.shard_sizes = (
             None if shard_sizes is None
@@ -243,7 +277,10 @@ class HostDataLoader:
         else:
             if window is None:
                 raise ValueError("window is required (single-source stream)")
-            self.n = self.n_rows
+            # a plain-base stream's per-horizon index space is H; the
+            # absolute indices served for horizon g land in [g*H, (g+1)*H)
+            # and the data must cover every appended sample
+            self.n = self.horizon if self.streaming else self.n_rows
         if not 0 <= rank < world:
             raise ValueError(f"rank must be in [0, {world}), got {rank}")
         if depth < 1:
@@ -299,12 +336,31 @@ class HostDataLoader:
         self._boundary_lock = new_lock("loader.boundary")
         self._boundary_thread: Optional[threading.Thread] = None
         self._boundary_box = None  # (epoch, generation, idx, exc)
+        #: highest horizon generation served (streaming only): a bump is
+        #: an epoch boundary for every cache — stale-horizon indices must
+        #: never outlive an advance (docs/STREAMING.md)
+        self._stream_gen = -1
         # ONE description of this loader's stream, shared verbatim with the
         # index service (service/spec.py) — local regen and a daemon serving
         # the same config cannot drift because both evaluate this object
         from ..service.spec import PartialShuffleSpec
 
-        if self.mixture is not None:
+        if self.streaming:
+            from ..streaming import StreamSpec
+
+            if self.mixture is not None:
+                self.stream_spec = StreamSpec.mixture_stream(
+                    self.horizon, mixture=self.mixture, seed=self.seed,
+                    world=self.world, backend=self.index_backend,
+                    **self.kwargs,
+                )
+            else:
+                self.stream_spec = StreamSpec.plain_stream(
+                    self.horizon, window=self.window, seed=self.seed,
+                    world=self.world, backend=self.index_backend,
+                    **self.kwargs,
+                )
+        elif self.mixture is not None:
             self.stream_spec = PartialShuffleSpec.mixture(
                 self.mixture, seed=self.seed, world=self.world,
                 epoch_samples=self.epoch_samples,
@@ -401,6 +457,18 @@ class HostDataLoader:
         so the second O(num_samples) regen+expansion would be pure
         waste.  Dropped once the epoch generator is exhausted (or via
         :meth:`clear_cache`) so the array doesn't outlive its epoch."""
+        if self.streaming and int(epoch) != self._stream_gen:
+            # horizon-generation bump = epoch boundary for every cache:
+            # drop the previous horizon's index array and any boundary
+            # box for a DIFFERENT horizon, so no stale-horizon indices
+            # can be served after an advance (docs/STREAMING.md); a
+            # prefetch for exactly this horizon is still adoptable
+            self._idx_cache = None
+            with self._boundary_lock:
+                box = self._boundary_box
+                if box is not None and box[0] != int(epoch):
+                    self._boundary_box = None
+            self._stream_gen = int(epoch)
         key = (int(epoch),
                None if layers is None
                else tuple((int(w), int(c)) for w, c in layers))

@@ -192,6 +192,17 @@ class DeviceEpochIterator:
             yield idx[whole * self.batch:]
 
     def epoch(self, epoch: int) -> Iterator[jax.Array]:
+        epoch = int(epoch)
+        # an epoch (or streaming horizon-generation, docs/STREAMING.md)
+        # bump is a boundary for every cache: entries BELOW the epoch
+        # being served can never be legitimately served again — a
+        # moving-horizon stream only advances — so drop them now rather
+        # than letting a stale horizon's indices (or its HBM) outlive
+        # the advance
+        for k in [k for k in self._cache if k < epoch]:
+            del self._cache[k]
+        for k in [k for k in self._ring if k < epoch]:
+            del self._ring[k]
         idx = self.epoch_array(epoch)
         # adopt this epoch's pre-split first chunk BEFORE dispatching the
         # next boundary (the ring holds at most one epoch)

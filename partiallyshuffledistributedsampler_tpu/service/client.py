@@ -68,7 +68,9 @@ heartbeat acks drive it exactly as batch requests would.
 
 from __future__ import annotations
 
+import os
 import socket
+import threading
 import time
 from collections import deque
 from typing import Iterator, Optional
@@ -98,6 +100,10 @@ _FATAL_CODES = frozenset(
 #: consecutive checksum rejects on one seq before the client gives up on
 #: re-requesting (a link that corrupts every replay is not transient)
 _MAX_CHECKSUM_REJECTS = 4
+
+#: process-wide feeder-id allocator for APPEND exactly-once dedup
+_FEEDER_LOCK = threading.Lock()
+_FEEDER_SEQ = 0
 
 
 class ServiceError(RuntimeError):
@@ -229,6 +235,7 @@ class ServiceIndexClient:
         capability_secret=None,
         capability_heartbeat_s: float = 1.0,
         clock=None,
+        attach: bool = False,
     ) -> None:
         self.address = _parse_address(address)
         self.rank = None if rank is None else int(rank)
@@ -323,6 +330,23 @@ class ServiceIndexClient:
         #: perf_counter at failover start — observed into ``failover_ms``
         #: at the first successful WELCOME after it
         self._failover_t0: Optional[float] = None
+        # -------- moving-horizon streaming (docs/STREAMING.md) --------
+        #: attach-only (feeder) mode: HELLO binds the namespace without
+        #: claiming a rank lease — a feeder holding a lease would count
+        #: as a permanent straggler and deadlock the advance barrier
+        self._attach = bool(attach)
+        #: stable feeder id + monotonic per-append sequence: every retry
+        #: of one logical APPEND carries the same ``(feeder, stream_seq)``
+        #: pair, so a reply lost on the wire is re-answered as a
+        #: duplicate, never double-counted.  The id must never repeat
+        #: within a process lifetime — ``id(self)`` would, once a dead
+        #: feeder is collected and its address reused, silently dedup a
+        #: NEW feeder's first append as a replay
+        with _FEEDER_LOCK:
+            global _FEEDER_SEQ
+            _FEEDER_SEQ += 1
+            self._feeder = f"{os.getpid()}-{_FEEDER_SEQ}"
+        self._stream_seq = -1
 
     # ----------------------------------------------------------- connection
     #: dial → redirect hops one ``_connect`` tolerates before handing the
@@ -423,6 +447,9 @@ class ServiceIndexClient:
             hello["spec"] = self.expected_spec.to_wire()
         if self.tenant is not None:
             hello["tenant"] = self.tenant
+        if self._attach:
+            # feeder mode: admit the namespace only — no rank lease
+            hello["attach"] = True
         try:
             P.send_msg(sock, P.MSG_HELLO, hello)
             msg, header, _ = P.recv_msg(sock)
@@ -436,6 +463,15 @@ class ServiceIndexClient:
                 return False, header
             raise _typed_error(header.get("code", "error"),
                                header.get("detail", ""), header)
+        if self._attach and msg == P.MSG_OK:
+            # attach-only HELLO is answered OK (not WELCOME): adopt the
+            # tenant binding and keep the leaseless connection
+            t = header.get("tenant")
+            if t is not None:
+                self.tenant = str(t)
+            self._sock = sock
+            self._promote_on_connect = False
+            return True, None
         if msg != P.MSG_WELCOME:
             sock.close()
             raise P.ProtocolError(
@@ -727,6 +763,22 @@ class ServiceIndexClient:
                     # owner and re-HELLO there (docs/SHARDING.md)
                     self.close()
                     self._on_wrong_shard(rheader)
+                    retry_s = float(rheader.get("retry_ms", 25)) / 1e3
+                    if not op.pause(min_delay=retry_s):
+                        raise ServiceError(code, rheader.get("detail", ""),
+                                           rheader)
+                    continue
+                if code in ("horizon_pending", "horizon_advance",
+                            "stream_append"):
+                    # moving-horizon backpressure (docs/STREAMING.md):
+                    # the horizon is not fully appended yet, the advance
+                    # barrier is waiting on straggler ranks (or an
+                    # injected abort rolled it back), or an injected
+                    # append fault fired.  All retryable: GET_BATCH/
+                    # GET_CAPABILITY replays are exactly-once by the
+                    # cursor law, and APPEND replays are deduplicated by
+                    # ``(feeder, stream_seq)``.
+                    self.metrics.inc("stream_waits", self.rank)
                     retry_s = float(rheader.get("retry_ms", 25)) / 1e3
                     if not op.pause(min_delay=retry_s):
                         raise ServiceError(code, rheader.get("detail", ""),
@@ -1256,6 +1308,113 @@ class ServiceIndexClient:
         _, rheader, _ = self._rpc(P.MSG_RESHARD, {"world": int(new_world)})
         return rheader
 
+    # ----------------------------------------------------------- streaming
+    def append(self, count: int, *, weights_delta=None) -> dict:
+        """Feeder op (docs/STREAMING.md): extend the stream's append-only
+        index space by ``count`` samples.  Exactly-once under the retry
+        layer — one logical append carries one ``(feeder, stream_seq)``
+        pair across every wire attempt, and the server answers a replay
+        as ``duplicate`` without re-counting.  ``weights_delta`` is an
+        additive per-source mixture re-weighting, folded in at the next
+        horizon advance.  Feeders should connect with ``attach=True`` so
+        they never hold a rank lease (a leased feeder would stall the
+        advance barrier as a permanent straggler).  Returns the OK
+        header: ``appended`` (absolute total), ``eligible`` (servable
+        horizons) and ``epoch`` (the stream's current horizon)."""
+        self._stream_seq += 1
+        header = {"count": int(count), "stream_seq": int(self._stream_seq),
+                  "feeder": self._feeder}
+        if weights_delta is not None:
+            header["weights_delta"] = [int(x) for x in weights_delta]
+        _, rheader, _ = self._rpc(P.MSG_APPEND, header)
+        return rheader
+
+    def stream_batches(self, *, start_horizon: int = 0,
+                       horizons: Optional[int] = None,
+                       start_seq: int = 0) -> Iterator[np.ndarray]:
+        """The epochless consumption loop: serve horizon generations
+        ``start_horizon, start_horizon + 1, ...`` back to back, each via
+        :meth:`epoch_batches`.  No explicit advance call exists — the
+        first request naming the next horizon *is* the ack-gated advance
+        barrier, and the typed ``horizon_pending``/``horizon_advance``
+        refusals pace this generator until the horizon is appended and
+        every rank has drained the previous one (docs/STREAMING.md).
+        Unbounded when ``horizons`` is None; yields stay exactly-once
+        across faults, failover and mid-stream reshards exactly as one
+        ``epoch_batches`` stream does.  A reshard that commits around a
+        horizon boundary re-deals the horizon's pooled remainder over
+        the NEW world — possibly to a rank that already finished it —
+        so this loop re-enters the horizon whenever the generation moved
+        under it (the post-commit array holds only the un-delivered
+        share, making the re-entry exactly-once by construction)."""
+        g = int(start_horizon)
+        seq = int(start_seq)
+        end = None if horizons is None else g + int(horizons)
+        regen_retry = -1  # generation already backed up for, at most once
+        while end is None or g < end:
+            g_gen = self.generation
+            try:
+                yield from self.epoch_batches(g, start_seq=seq)
+            except ServiceError as exc:
+                if (exc.code == "horizon_advance" and g > 0
+                        and self.generation != g_gen
+                        and self.generation != regen_retry):
+                    # a reshard was adopted while we waited to advance
+                    # into g: the previous horizon's remainder was
+                    # re-dealt and this rank may hold an unserved share
+                    # — back up one horizon (the post-commit array is
+                    # only the remainder, so the replay is exactly-once
+                    # by construction), then retry the advance (once per
+                    # generation, so a genuinely-stuck peer still
+                    # surfaces the error).  A reshard epoch_batches rode
+                    # through internally needs none of this: it already
+                    # served the re-dealt share before returning.
+                    regen_retry = self.generation
+                    yield from self.epoch_batches(g - 1, start_seq=0)
+                    seq = 0
+                    continue
+                raise
+            seq = 0
+            g += 1
+
+    def capability_stream_batches(self, *, spec=None,
+                                  start_horizon: int = 0,
+                                  horizons: Optional[int] = None,
+                                  start_seq: int = 0
+                                  ) -> Iterator[np.ndarray]:
+        """The zero-index-bytes epochless loop: one signed grant per
+        horizon generation (its ``epoch`` IS the horizon gen, its
+        ``stream_weights`` the horizon's effective mixture weights),
+        regenerated on-device via :meth:`capability_epoch_batches`.  A
+        horizon advance surfaces exactly like a membership change —
+        ``capability_stale``-style re-fetch — and the typed streaming
+        refusals pace the first grant of each new horizon
+        (docs/STREAMING.md).  Horizon re-entry after a mid-stream
+        reshard mirrors :meth:`stream_batches`: a moved generation means
+        the remainder was re-dealt, so the horizon is replayed (the
+        fresh grant regenerates only the rank's new share)."""
+        g = int(start_horizon)
+        seq = int(start_seq)
+        end = None if horizons is None else g + int(horizons)
+        regen_retry = -1
+        while end is None or g < end:
+            g_gen = self.generation
+            try:
+                yield from self.capability_epoch_batches(
+                    g, spec=spec, start_seq=seq)
+            except ServiceError as exc:
+                if (exc.code == "horizon_advance" and g > 0
+                        and self.generation != g_gen
+                        and self.generation != regen_retry):
+                    regen_retry = self.generation
+                    yield from self.capability_epoch_batches(
+                        g - 1, spec=spec, start_seq=0)
+                    seq = 0
+                    continue
+                raise
+            seq = 0
+            g += 1
+
     # ---------------------------------------------------------- capability
     def _fetch_capability(self, epoch: int, spec) -> EpochCapability:
         """Obtain and verify the signed epoch capability for ``epoch``.
@@ -1405,8 +1564,17 @@ class ServiceIndexClient:
             layers = self.layers if (
                 self.elastic_epoch is not None
                 and int(self.elastic_epoch) == epoch) else []
-            arr = membership_stream(spec, epoch, self.rank, self.world,
-                                    layers, self.orphans)
+            sw = getattr(cap, "stream_weights", None)
+            regen_spec = spec
+            if sw is not None and hasattr(spec, "with_stream_weights"):
+                # moving-horizon mixture stream: the signed grant carries
+                # the horizon's EFFECTIVE weights (base + every delta
+                # folded at advances <= epoch), so on-device regen folds
+                # the re-weighted horizon bit-identically to the served
+                # path (docs/STREAMING.md "Weight-update protocol")
+                regen_spec = spec.with_stream_weights({epoch: tuple(sw)})
+            arr = membership_stream(regen_spec, epoch, self.rank,
+                                    self.world, layers, self.orphans)
             total = int(arr.shape[0])
             refetch = False
             while not refetch:

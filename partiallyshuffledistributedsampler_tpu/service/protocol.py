@@ -132,6 +132,33 @@ configured; use the served-batch path).  Both frame types are additive
 within protocol version 2: a deployment that never requests a
 capability puts zero extra bytes on the wire.
 
+Streaming frames (docs/STREAMING.md — epochless moving-horizon shuffle):
+
+    APPEND → OK | ERROR              a feeder extends the append-only
+                                     index space by ``count`` samples;
+                                     idempotent under retry via the
+                                     monotonic ``stream_seq`` per
+                                     ``feeder`` id, MAY carry an
+                                     additive per-source
+                                     ``weights_delta`` folded into the
+                                     mixture weights at the next
+                                     horizon advance.  The ``OK`` reply
+                                     carries ``appended``, ``eligible``
+                                     (fully-appended horizons) and the
+                                     stream's current horizon ``epoch``.
+
+On a stream-mode spec the epoch number of ``GET_BATCH`` /
+``GET_CAPABILITY`` *is* the horizon generation; the server gates it with
+typed retryable refusals: ``horizon_pending`` (the horizon is not fully
+appended yet — the header carries ``appended``/``eligible`` and
+``retry_ms``), ``horizon_advance`` (the ack-gated advance barrier is
+waiting on straggler ranks, or an injected ``stream.advance`` fault
+aborted the advance before any state moved — retry and the barrier
+resolves), ``stream_append`` (an injected/transient ``stream.append``
+fault refused the APPEND; retryable — the ``stream_seq`` makes the
+retry exact-once).  All are additive within protocol version 2: a
+frozen-dataset deployment never sees them.
+
 Tracing: any request header MAY carry ``trace=[trace_id, span_id]`` —
 the sender's open span context (docs/OBSERVABILITY.md).  Receivers that
 know about it parent their dispatch span under it; receivers that don't
@@ -185,6 +212,9 @@ MSG_REPL_PROMOTE = 19
 # a client that never sends GET_CAPABILITY pays zero protocol overhead
 MSG_GET_CAPABILITY = 20
 MSG_CAPABILITY = 21
+# additive-within-v2: the moving-horizon stream's feeder frame
+# (docs/STREAMING.md) — a frozen-dataset deployment never sends it
+MSG_APPEND = 22
 
 _NAMES = {
     v: k[len("MSG_"):] for k, v in list(globals().items())

@@ -126,7 +126,7 @@ SNAPSHOT_KIND = "index_service"
 #: REPL_* feed are exempt)
 _MUTATING_MSGS = frozenset({
     P.MSG_HELLO, P.MSG_GET_BATCH, P.MSG_SET_EPOCH, P.MSG_HEARTBEAT,
-    P.MSG_LEAVE, P.MSG_RESHARD, P.MSG_GET_CAPABILITY,
+    P.MSG_LEAVE, P.MSG_RESHARD, P.MSG_GET_CAPABILITY, P.MSG_APPEND,
 })
 
 
@@ -137,6 +137,21 @@ def _state_crc(state: dict) -> int:
     body = json.dumps({k: v for k, v in state.items() if k != "crc32"},
                       sort_keys=True, separators=(",", ":")).encode()
     return zlib.crc32(body) & 0xFFFFFFFF
+
+
+def _cursor_from_wire(c: dict) -> dict:
+    """Rebuild one rank's batch cursor from a snapshot/WAL/replication
+    record.  The streaming-only keys (``batch``, ``total`` — the advance
+    barrier's pinned per-rank target, docs/STREAMING.md) must survive
+    every restore path, or a recovered/promoted server would refuse the
+    next horizon advance as a permanent straggler; frozen-dataset
+    cursors carry neither and restore byte-identically."""
+    cur = {"epoch": int(c["epoch"]), "acked": int(c["acked"]),
+           "hi": int(c["hi"]), "samples": int(c.get("samples", 0))}
+    for k in ("batch", "total"):
+        if c.get(k) is not None:
+            cur[k] = int(c[k])
+    return cur
 
 
 class IndexServer(DispatchListener):
@@ -241,6 +256,23 @@ class IndexServer(DispatchListener):
         self._cap_records: dict[int, dict] = {}  # guarded by: self._lock
         #: rank -> clock time its lease went vacant (membership_timeout)
         self._vacated: dict[int, float] = {}  # guarded by: self._lock
+        # ---- moving-horizon streaming (docs/STREAMING.md) ----
+        #: True when the spec is a StreamSpec: ``self.epoch`` is the
+        #: current horizon generation, and GET_BATCH/GET_CAPABILITY run
+        #: the eligibility + ack-gated advance gate before serving
+        self.streaming = getattr(spec, "mode", None) == "stream"
+        #: absolute appended-sample total — monotonic, so a WAL replay
+        #: takes the max and a dropped append record can only UNDER-count
+        #: (the eligibility gate then serves later, never twice)
+        self._stream_appended = 0  # guarded by: self._lock
+        #: feeder id -> last applied stream_seq (APPEND retry dedup)
+        self._stream_seqs: dict[str, int] = {}  # guarded by: self._lock
+        #: accumulated additive per-source weights delta, folded into the
+        #: spec's per-horizon weights at the next advance
+        self._stream_pending: Optional[list] = None  # guarded by: self._lock
+        #: horizon gen -> perf stamp of the append that opened it, popped
+        #: into ``append_visible_ms`` when the horizon completes
+        self._stream_first_t: dict[int, float] = {}  # guarded by: self._lock
         self._listener: Optional[socket.socket] = None
         self._threads: list[threading.Thread] = []
         self._conn_socks: dict[int, socket.socket] = {}
@@ -628,6 +660,20 @@ class IndexServer(DispatchListener):
             "capabilities": {str(r): dict(rec)
                              for r, rec in self._cap_records.items()},
         }
+        if self.streaming:
+            # additive within format 2 (docs/STREAMING.md): absent for
+            # every frozen-dataset snapshot, which stays byte-identical.
+            # Totals are absolute and seqs are maxima, so restoring an
+            # older checkpoint plus the WAL tail converges on the truth.
+            state["stream"] = {
+                "appended": int(self._stream_appended),
+                "seqs": {str(k): int(v)
+                         for k, v in self._stream_seqs.items()},
+                "pending": (list(self._stream_pending)
+                            if self._stream_pending is not None else None),
+                "weights": {str(g): [int(x) for x in w]
+                            for g, w in self.spec.stream_weights.items()},
+            }
         if self._wal is not None and self._repl_log is not None:
             # the WAL position this snapshot reflects — recovery
             # replays the tail strictly above it.  Exact: every append
@@ -751,9 +797,7 @@ class IndexServer(DispatchListener):
             self.epoch = int(state.get("epoch", 0))
             self._ckpt_lsn = int(state.get("wal_lsn", 0))
             self._cursors = {
-                int(r): {"epoch": int(c["epoch"]), "acked": int(c["acked"]),
-                         "hi": int(c["hi"]),
-                         "samples": int(c.get("samples", 0))}
+                int(r): _cursor_from_wire(c)
                 for r, c in state.get("cursors", {}).items()
             }
             if fmt < 2:
@@ -777,6 +821,21 @@ class IndexServer(DispatchListener):
             }
             if theirs.world != self.spec.world:
                 self.spec = self.spec.with_world(theirs.world)
+            st = state.get("stream")
+            if self.streaming and st is not None:
+                self._stream_appended = max(self._stream_appended,
+                                            int(st.get("appended", 0)))
+                for k, v in (st.get("seqs") or {}).items():
+                    self._stream_seqs[str(k)] = max(
+                        self._stream_seqs.get(str(k), -1), int(v))
+                p = st.get("pending")
+                self._stream_pending = (None if p is None
+                                        else [int(x) for x in p])
+                w = st.get("weights") or {}
+                if w:
+                    self.spec = self.spec.with_stream_weights(
+                        {int(g): tuple(int(x) for x in ws)
+                         for g, ws in w.items()})
             rs = state.get("reshard")
             if rs is not None:
                 self._reshard = {
@@ -1007,9 +1066,7 @@ class IndexServer(DispatchListener):
             for r, c in (state.get("capabilities") or {}).items()
         }
         self._cursors = {
-            int(r): {"epoch": int(c["epoch"]), "acked": int(c["acked"]),
-                     "hi": int(c["hi"]),
-                     "samples": int(c.get("samples", 0))}
+            int(r): _cursor_from_wire(c)
             for r, c in (state.get("cursors") or {}).items()
         }
         for r, b in (state.get("leases") or {}).items():
@@ -1017,6 +1074,19 @@ class IndexServer(DispatchListener):
                 int(r), {"owner": None, "last_seen": self._clock(),
                          "batch": 0})
             l["batch"] = int(b)
+        st = state.get("stream")
+        if self.streaming and st is not None:
+            self._stream_appended = int(st.get("appended", 0))
+            self._stream_seqs = {str(k): int(v)
+                                 for k, v in (st.get("seqs") or {}).items()}
+            p = st.get("pending")
+            self._stream_pending = (None if p is None
+                                    else [int(x) for x in p])
+            w = st.get("weights") or {}
+            if w:
+                self.spec = self.spec.with_stream_weights(
+                    {int(g): tuple(int(x) for x in ws)
+                     for g, ws in w.items()})
         rs = state.get("reshard")
         if rs is not None:
             self._reshard = {
@@ -1062,10 +1132,7 @@ class IndexServer(DispatchListener):
                 l["owner"] = None
             self._vacated.setdefault(int(rec["rank"]), self._clock())
         elif op == "cursor":
-            self._cursors[int(rec["rank"])] = {
-                "epoch": int(rec["epoch"]), "acked": int(rec["acked"]),
-                "hi": int(rec["hi"]), "samples": int(rec["samples"]),
-            }
+            self._cursors[int(rec["rank"])] = _cursor_from_wire(rec)
         elif op == "state":
             self._apply_state_locked(rec.get("state") or {})
         elif op == "seal":
@@ -1084,6 +1151,32 @@ class IndexServer(DispatchListener):
                 "epoch": int(rec["epoch"]), "gen": int(rec["gen"]),
                 "total": int(rec["total"]),
             }
+        elif op == "stream":
+            # moving-horizon records (docs/STREAMING.md) carry ABSOLUTE
+            # totals and per-feeder seq maxima, so a dropped/torn append
+            # record is re-established by the next one — replay can only
+            # under-count, and the eligibility gate then serves later,
+            # never a sample twice
+            self._stream_appended = max(self._stream_appended,
+                                        int(rec.get("appended", 0)))
+            for k, v in (rec.get("seqs") or {}).items():
+                self._stream_seqs[str(k)] = max(
+                    self._stream_seqs.get(str(k), -1), int(v))
+            if "pending" in rec:
+                p = rec.get("pending")
+                self._stream_pending = (None if p is None
+                                        else [int(x) for x in p])
+            ep = rec.get("epoch")
+            if ep is not None:
+                # an advance record: adopt the folded weights first,
+                # then the horizon generation (the pending delta it
+                # consumed is spent)
+                w = rec.get("weights")
+                if w is not None and self.streaming:
+                    self.spec = self.spec.with_stream_weights(
+                        {int(ep): tuple(int(x) for x in w)})
+                self.epoch = max(self.epoch, int(ep))
+                self._stream_pending = None
         # unknown ops fall through: the record vocabulary is additive
 
     def _on_repl_sync(self, sock, header) -> None:
@@ -1418,6 +1511,8 @@ class IndexServer(DispatchListener):
             engine._on_heartbeat(sock, conn_id, header)
         elif msg == P.MSG_GET_CAPABILITY:
             engine._on_get_capability(sock, conn_id, header)
+        elif msg == P.MSG_APPEND:
+            engine._on_append(sock, header)
         elif msg == P.MSG_SNAPSHOT:
             engine._write_snapshot(force=True)
             P.send_msg(sock, P.MSG_SNAPSHOT_STATE,
@@ -1570,6 +1665,17 @@ class IndexServer(DispatchListener):
         """The signed grant for the CURRENT membership — one HMAC over
         the canonical encoding (docs/CAPABILITY.md).  Under
         ``self._lock``."""
+        extra = {}
+        if self.streaming:
+            # the horizon's effective mixture weights ride the grant
+            # (docs/STREAMING.md): regen on the client substitutes them
+            # before evaluating, so a re-weighted horizon folds
+            # bit-identically on device.  Absent for plain-base streams
+            # and for every frozen-dataset grant (old grants verify
+            # unchanged).
+            w = self.spec.weights_for(int(epoch))
+            if w is not None:
+                extra["stream_weights"] = tuple(int(x) for x in w)
         return EpochCapability(
             fingerprint=self.spec.fingerprint(include_world=False),
             epoch=int(epoch),
@@ -1580,6 +1686,7 @@ class IndexServer(DispatchListener):
             elastic_epoch=self.elastic_epoch,
             orphans=tuple(dict(o) for o in self._orphans),
             tenant=self.tenant_id,
+            **extra,
         ).signed(self.capability_secret)
 
     def _on_get_capability(self, sock, conn_id, header) -> None:
@@ -1622,6 +1729,7 @@ class IndexServer(DispatchListener):
             })
             return
         t0 = time.perf_counter()
+        advanced = False
         with self._lock:
             lease = self._leases.get(rank)
             if lease is None or lease.get("owner") != conn_id:
@@ -1632,6 +1740,14 @@ class IndexServer(DispatchListener):
                 })
                 return
             self._touch(rank, lease)
+            if self.streaming:
+                # eligibility + ack-gated advance, BEFORE any cursor
+                # mutation (docs/STREAMING.md): a refused request leaves
+                # the stream state exactly as it found it
+                refusal, advanced = self._stream_gate_locked(epoch)
+                if refusal is not None:
+                    P.send_msg(sock, P.MSG_ERROR, refusal)
+                    return
             rs = self._reshard
             if rs is not None and rs.get("phase") == "freeze":
                 # a grant issued mid-freeze could outrun the watermark
@@ -1643,6 +1759,11 @@ class IndexServer(DispatchListener):
                 })
                 return
             cur_gen = self.generation
+        if advanced:
+            # the horizon advance this request committed seals a forced
+            # checkpoint (outside the lock — the writer retakes it) so
+            # the WAL truncates below the new watermark
+            self._stream_advanced(t0)
         # the rank's total (rank 0's orphan prefix included) anchors the
         # consumption slack; _rank_array takes self._lock, so this MUST
         # stay outside it
@@ -1662,6 +1783,12 @@ class IndexServer(DispatchListener):
                 cur = self._cursors[rank] = {"epoch": epoch, "acked": -1,
                                              "hi": -1, "samples": 0}
             batch = int(lease.get("batch") or 0)
+            if self.streaming:
+                # capability-mode ranks serve no slices, so the advance
+                # barrier's per-rank target is pinned at issuance; the
+                # ack-to-samples batch rides along for post-lease gating
+                cur["total"] = int(total)
+                cur["batch"] = batch
             # consumption floor: the client may locally deliver up to
             # max_inflight batches before its first ack flush, and a
             # barrier freezing in that window must still cover them
@@ -2079,6 +2206,30 @@ class IndexServer(DispatchListener):
                     self._leases[rank]["owner"] = None
                 self._vacated[rank] = now
         self._reshard = None
+        if self.streaming and epoch == self.epoch:
+            # re-pin the advance barrier's per-rank targets under the
+            # NEW partition (docs/STREAMING.md "Advance under reshard"):
+            # post-commit arrays hold only each rank's un-delivered
+            # remainder share, served from seq 0, so every cursor
+            # restarts at acked=-1 with the layer-aware share as its
+            # total — a rank whose share is empty passes the straggler
+            # test without ever sending a request, and a rank that
+            # finished the horizon pre-freeze but was dealt a share of
+            # the pooled remainder blocks the advance until it re-enters
+            # the horizon (rank_indices is pure spec math, so calling it
+            # under the lock is deadlock-free; commits are rare)
+            layers = [(int(w), int(c)) for w, c in self.layers]
+            for r in range(self.spec.world):
+                share = int(np.asarray(self.spec.rank_indices(
+                    epoch, r, layers=layers or None)).shape[0])
+                if r == 0:
+                    share += self._orphan_len_locked(epoch)
+                lease = self._leases.get(r) or {}
+                self._cursors[r] = {
+                    "epoch": epoch, "acked": -1, "hi": -1, "samples": 0,
+                    "batch": int(lease.get("batch") or 0),
+                    "total": share,
+                }
         if new_orphans:
             self.metrics.inc("orphaned", value=sum(
                 int(o["hi"]) - int(o["lo"]) for o in new_orphans))
@@ -2479,6 +2630,8 @@ class IndexServer(DispatchListener):
         # with the fresh membership — exactly what its sender must adopt
         self._apply_piggyback_ack(conn_id, rank, header.get("hb"))
         gen = int(header.get("gen", 0))
+        t_req = time.perf_counter()
+        advanced = False
         with self._lock:
             if gen != self.generation:
                 # the request names a stream of a committed-away
@@ -2505,11 +2658,28 @@ class IndexServer(DispatchListener):
                 })
                 return
             self._touch(rank, lease)
+            if self.streaming:
+                # eligibility + ack-gated advance, BEFORE the cursor
+                # reset below (docs/STREAMING.md): a refused request
+                # must leave every rank's horizon cursor intact so the
+                # barrier's straggler test stays truthful
+                refusal, advanced = self._stream_gate_locked(epoch)
+                if refusal is not None:
+                    P.send_msg(sock, P.MSG_ERROR, refusal)
+                    return
             batch = lease["batch"]
             cur = self._cursors.get(rank)
             if cur is None or cur["epoch"] != epoch:
                 cur = self._cursors[rank] = {"epoch": epoch, "acked": -1,
                                              "hi": -1, "samples": 0}
+            if self.streaming:
+                # the advance barrier converts acked seqs to samples
+                # with this batch; keeping it on the cursor preserves
+                # the conversion after the lease is gone (a finished
+                # rank that disconnected before the advance), and
+                # refreshing it heals a commit-re-pinned cursor created
+                # before this rank held a lease
+                cur["batch"] = int(batch)
             ack = header.get("ack")
             acked_advanced = False
             if ack is not None and int(ack) > cur["acked"]:
@@ -2578,6 +2748,11 @@ class IndexServer(DispatchListener):
                 else:
                     clamp = t
             resend = seq <= cur["hi"]
+        if advanced:
+            # the horizon advance this request committed seals a forced
+            # checkpoint (outside the lock — the writer retakes it) so
+            # the WAL truncates below the new watermark
+            self._stream_advanced(t_req)
         if reply is not None:
             if committed:
                 self._write_snapshot(force=True)
@@ -2589,7 +2764,7 @@ class IndexServer(DispatchListener):
         total = int(arr.shape[0])
         limit = total if clamp is None else min(clamp, total)
         if lo >= limit:
-            if acked_advanced:
+            if acked_advanced or (self.streaming and clamp is None):
                 # the epoch's terminal ack rides the EOF poll and no
                 # slice is served below, so the usual served-slice
                 # cursor append never runs — persist the advance here
@@ -2597,7 +2772,15 @@ class IndexServer(DispatchListener):
                 with self._lock:
                     cur = self._cursors.get(rank)
                     if cur is not None and cur["epoch"] == epoch:
-                        self._repl_append("cursor", rank=rank, **cur)
+                        if self.streaming and clamp is None:
+                            # the horizon's layer-aware end — what the
+                            # advance barrier's straggler test compares
+                            # acked delivery against; MUST come from
+                            # _rank_array (a mid-horizon reshard shrinks
+                            # remainder allocations below num_samples)
+                            cur["total"] = int(total)
+                        if acked_advanced:
+                            self._repl_append("cursor", rank=rank, **cur)
             P.send_msg(sock, P.MSG_BATCH,
                        {"seq": seq, "eof": True, "total": total,
                         "end": limit, "gen": gen})
@@ -2638,6 +2821,13 @@ class IndexServer(DispatchListener):
                 if cur is not None and cur["epoch"] == epoch:
                     cur["hi"] = max(cur["hi"], seq)
                     cur["samples"] = max(int(cur.get("samples", 0)), end)
+                    if self.streaming and clamp is None and end >= limit:
+                        # last slice of the horizon: pin the layer-aware
+                        # end on the cursor so the terminal ack (which
+                        # may arrive piggybacked, with no further
+                        # GET_BATCH for this horizon) satisfies the
+                        # advance barrier — and replicates with it
+                        cur["total"] = int(limit)
                     self._repl_append("cursor", rank=rank, **cur)
         if stale is not None:
             P.send_msg(sock, P.MSG_ERROR, stale)
@@ -2650,3 +2840,230 @@ class IndexServer(DispatchListener):
                    {"seq": seq, "eof": False, "total": total, "end": end,
                     "gen": gen, **fields},
                    payload)
+
+    # ---------------------------------------- moving-horizon streaming
+    def _on_append(self, sock, header) -> None:
+        """A feeder extends the append-only index space
+        (docs/STREAMING.md).  Exactly-once under retries rests on two
+        invariants, never on any single WAL append landing: the appended
+        total is ABSOLUTE in every ``stream`` record (replay takes the
+        max), and ``stream_seq`` is monotonic per feeder id, so a
+        retried APPEND whose first attempt half-landed is recognized and
+        answered with the current totals instead of re-applied."""
+        if not self.streaming:
+            P.send_msg(sock, P.MSG_ERROR, {
+                "code": "bad_request",
+                "detail": "APPEND against a non-stream spec; only "
+                          "mode='stream' index spaces grow",
+            })
+            return
+        try:
+            count = int(header["count"])
+            seq = int(header.get("stream_seq", 0))
+            feeder = str(header.get("feeder", ""))
+        except (KeyError, TypeError, ValueError):
+            P.send_msg(sock, P.MSG_ERROR, {
+                "code": "bad_request",
+                "detail": "APPEND needs an int count",
+            })
+            return
+        if count < 0:
+            P.send_msg(sock, P.MSG_ERROR, {
+                "code": "bad_request", "detail": f"count {count} < 0"})
+            return
+        try:
+            F.fire("stream.append")
+        except F.InjectedThreadDeath:
+            raise
+        except Exception as exc:
+            # the site fires BEFORE any mutation: an injected append
+            # fault is a clean retryable refusal, and the feeder's
+            # stream_seq makes the retry exactly-once
+            _annotate(error_code="stream_append")
+            P.send_msg(sock, P.MSG_ERROR, {
+                "code": "stream_append", "retry_ms": 25,
+                "detail": f"append refused ({exc!r}); retry",
+            })
+            return
+        delta = header.get("weights_delta")
+        h = int(self.spec.horizon)
+        with self._lock:
+            if self._stream_seqs.get(feeder, -1) >= seq:
+                # a retry of an APPEND that already landed: answer with
+                # the current totals, mutate nothing
+                P.send_msg(sock, P.MSG_OK, {
+                    "appended": int(self._stream_appended),
+                    "eligible": int(self.spec.eligible_horizons(
+                        self._stream_appended)),
+                    "epoch": int(self.epoch), "stream_seq": seq,
+                    "duplicate": True,
+                })
+                return
+            before = self._stream_appended
+            self._stream_appended = before + count
+            self._stream_seqs[feeder] = seq
+            if delta is not None:
+                cur = self._stream_pending
+                self._stream_pending = (
+                    [int(x) for x in delta] if cur is None
+                    else [int(a) + int(b) for a, b in zip(cur, delta)])
+            now = time.perf_counter()
+            self._stream_first_t.setdefault(before // h, now)
+            for g in range(before // h, self._stream_appended // h):
+                # horizon g just completed — appended → servable
+                t_open = self._stream_first_t.pop(g, now)
+                self.metrics.registry.histogram(
+                    "append_visible_ms").observe((now - t_open) * 1e3)
+            self._repl_append(
+                "stream", appended=int(self._stream_appended),
+                seqs={str(k): int(v)
+                      for k, v in self._stream_seqs.items()},
+                pending=(list(self._stream_pending)
+                         if self._stream_pending is not None else None))
+            appended = self._stream_appended
+            eligible = self.spec.eligible_horizons(appended)
+            epoch = self.epoch
+        self.metrics.inc("stream_appends")
+        self._write_snapshot()
+        P.send_msg(sock, P.MSG_OK, {
+            "appended": int(appended), "eligible": int(eligible),
+            "epoch": int(epoch), "stream_seq": seq,
+        })
+
+    def _stream_stragglers_locked(self, g: int) -> list[int]:
+        """Ranks that have not ACKED their full horizon-``g`` allocation
+        — the advance barrier's completion test.  The per-rank target is
+        the ``total`` the serve path pinned on the rank's cursor (the
+        layer-aware end of its stream: a mid-horizon reshard shrinks
+        remainder allocations below ``spec.num_samples``, so the base
+        spec alone would deadlock the barrier).  A rank with no cursor
+        at all is excused only when its base allocation is zero; the
+        ack→samples conversion batch comes from the cursor so a finished
+        rank that already dropped its lease still passes.  Under
+        ``self._lock``."""
+        out = []
+        for r in range(self.spec.world):
+            cur = self._cursors.get(r)
+            if cur is None:
+                if int(self.spec.num_samples(r) or 0) > 0:
+                    out.append(r)
+                continue
+            total = cur.get("total")
+            if (int(cur["epoch"]) == int(g) and total is not None
+                    and int(total) <= 0):
+                # an empty allocation (e.g. a re-pinned zero remainder
+                # share after a reshard) is complete by definition — no
+                # request, lease or batch required
+                continue
+            b = int(cur.get("batch")
+                    or self._leases.get(r, {}).get("batch") or 0)
+            if (int(cur["epoch"]) != int(g) or total is None or b <= 0
+                    or (int(cur["acked"]) + 1) * b < int(total)):
+                out.append(r)
+        return out
+
+    def _stream_gate_locked(self, epoch: int):
+        """The eligibility + ack-gated advance gate on a streaming
+        request naming horizon ``epoch`` (docs/STREAMING.md).  Returns
+        ``(refusal, advanced)``: a typed ERROR header to refuse with (or
+        None to serve), and whether this request committed a horizon
+        advance — the caller then runs :meth:`_stream_advanced` outside
+        the lock.  Under ``self._lock``."""
+        epoch = int(epoch)
+        eligible = self.spec.eligible_horizons(self._stream_appended)
+        if epoch >= eligible:
+            # eligibility law: horizon g needs (g+1)*H appended samples
+            # — whole horizons only, so the permutation input is always
+            # the full block and the stream stays pure
+            _annotate(error_code="horizon_pending")
+            return ({
+                "code": "horizon_pending", "retry_ms": 25,
+                "appended": int(self._stream_appended),
+                "eligible": int(eligible),
+                "detail": f"horizon {epoch} is not fully appended "
+                          f"({self._stream_appended} samples, "
+                          f"{eligible} eligible horizons)",
+            }, False)
+        if epoch <= self.epoch:
+            # the current horizon, or an earlier one — both pure
+            # regenerable; resends below the watermark serve unchanged
+            return None, False
+        if epoch > self.epoch + 1:
+            _annotate(error_code="horizon_advance")
+            return ({
+                "code": "horizon_advance", "retry_ms": 25,
+                "epoch": int(self.epoch),
+                "detail": f"horizon {epoch} is {epoch - self.epoch} "
+                          f"ahead of the stream (at {self.epoch}); "
+                          "advance is one horizon at a time",
+            }, False)
+        stragglers = self._stream_stragglers_locked(self.epoch)
+        if stragglers:
+            _annotate(error_code="horizon_advance")
+            return ({
+                "code": "horizon_advance", "retry_ms": 25,
+                "epoch": int(self.epoch),
+                "detail": f"ranks {stragglers} have not acked their "
+                          f"full horizon-{self.epoch} allocation",
+            }, False)
+        try:
+            F.fire("stream.advance")
+        except F.InjectedThreadDeath:
+            raise
+        except Exception as exc:
+            # the site fires BEFORE any mutation, so an injected abort
+            # rolls back to exactly the pre-advance state
+            _annotate(error_code="horizon_advance")
+            return ({
+                "code": "horizon_advance", "retry_ms": 25,
+                "epoch": int(self.epoch),
+                "detail": f"advance aborted ({exc!r}); retry",
+            }, False)
+        self._stream_advance_locked(epoch)
+        return None, True
+
+    def _stream_advance_locked(self, new_epoch: int) -> None:
+        """Commit the horizon advance (caller already passed the
+        straggler + eligibility gates): fold the pending weights delta
+        into the spec's per-horizon weights, bump the horizon
+        generation, and log the absolute stream state.  Under
+        ``self._lock``."""
+        from ..streaming.spec import WEIGHTS_RETAIN
+
+        weights = None
+        if self._stream_pending is not None:
+            prev = self.spec.weights_for(self.epoch)
+            if prev is not None:
+                # additive deltas on top of the previous horizon's
+                # effective weights, floored at 1 (mixture weights are
+                # integer quotas — ops/mixture.py)
+                weights = tuple(
+                    max(1, int(a) + int(b))
+                    for a, b in zip(prev, self._stream_pending))
+                self.spec = self.spec.with_stream_weights(
+                    {int(new_epoch): weights},
+                    prune_below=int(new_epoch) - WEIGHTS_RETAIN // 2)
+            self._stream_pending = None
+        self.epoch = int(new_epoch)
+        self._repl_append(
+            "stream", appended=int(self._stream_appended),
+            epoch=int(self.epoch),
+            weights=(list(weights) if weights is not None else None))
+        telemetry.event("horizon_advance", epoch=int(self.epoch))
+
+    def _stream_advanced(self, t0: float) -> None:
+        """Post-advance persistence, OUTSIDE ``self._lock`` (the
+        snapshot writer retakes it): seal a forced checkpoint so the WAL
+        GC truncates every record below the new horizon's watermark —
+        server + WAL state stays O(horizon), not O(stream)
+        (docs/STREAMING.md "Bounded state")."""
+        wal = self._wal
+        before = len(wal.segment_paths()) if wal is not None else 0
+        self._write_snapshot(force=True)
+        if wal is not None:
+            dropped = before - len(wal.segment_paths())
+            if dropped > 0:
+                self.metrics.inc("stream_gc_truncations", value=dropped)
+        self.metrics.inc("horizon_advances")
+        self.metrics.registry.histogram("horizon_advance_ms").observe(
+            (time.perf_counter() - t0) * 1e3)

@@ -316,6 +316,12 @@ class PartialShuffleSpec:
 
     @classmethod
     def from_wire(cls, d: dict, *, backend: str = "cpu") -> "PartialShuffleSpec":
+        if d.get("mode") == "stream" and cls is PartialShuffleSpec:
+            # the moving-horizon stream (docs/STREAMING.md) rides the same
+            # wire surface; its subclass owns the round-trip
+            from ..streaming.spec import StreamSpec
+
+            return StreamSpec.from_wire(d, backend=backend)
         d = dict(d)
         kwargs = d.pop("kwargs", {})
         mk = d.pop("mixture_key", None)

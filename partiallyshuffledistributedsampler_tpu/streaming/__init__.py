@@ -1,0 +1,19 @@
+"""Epochless moving-horizon shuffle over an append-only index space.
+
+The frozen-dataset surfaces shuffle a fixed ``n`` and cut it into epochs;
+production corpora (C4 token shards, WebDataset tars) *grow while
+training*.  This package makes the index space append-only and the
+shuffle epochless (docs/STREAMING.md): samples become eligible when
+appended, are shuffled within a sliding **horizon** by the existing
+windowed-permutation kernels, and every horizon advance is a lightweight
+ack-gated barrier on the service's existing two-phase machinery — not a
+reshard (no cascade layer, no lease migration).
+
+:class:`StreamSpec` is the sampler-side value object; the service side
+(``APPEND`` frame, eligibility/advance gates, watermark-truncated state)
+lives in ``service/server.py`` and ``service/client.py``.
+"""
+
+from .spec import StreamSpec
+
+__all__ = ["StreamSpec"]

@@ -37,7 +37,8 @@ from partiallyshuffledistributedsampler_tpu.analysis import lockorder  # noqa: E
 #: tests in these groups drive the threaded service stack and must not
 #: leave non-daemon threads behind (docs/ANALYSIS.md "Thread-leak gate")
 _LEAK_CHECKED_MARKS = ("failover", "tenancy", "chaos", "elastic",
-                       "telemetry", "durability", "sharding", "capability")
+                       "telemetry", "durability", "sharding", "capability",
+                       "streaming")
 
 
 @pytest.fixture(autouse=True)

@@ -29,6 +29,7 @@ _NAMESPACES = (
     "partiallyshuffledistributedsampler_tpu.service",
     "partiallyshuffledistributedsampler_tpu.sharding",
     "partiallyshuffledistributedsampler_tpu.capability",
+    "partiallyshuffledistributedsampler_tpu.streaming",
     "partiallyshuffledistributedsampler_tpu.telemetry",
     "partiallyshuffledistributedsampler_tpu.utils",
 )
@@ -287,6 +288,50 @@ def test_capability_doc_cross_linked():
 
     res = (DOCS / "RESILIENCE.md").read_text()
     for site in ("capability.issue", "capability.verify"):
+        assert site in F.SITES and site in res
+
+
+def test_streaming_doc_cross_linked():
+    """Streaming mode is documented where an operator would look:
+    docs/STREAMING.md owns the horizon/eligibility/advance/re-weighting
+    story (and the make gate), SERVICE.md carries the APPEND frame and a
+    section pointing at it, API.md documents the knobs on every surface,
+    OBSERVABILITY.md the metric names, CAPABILITY.md the per-horizon
+    grants, and RESILIENCE.md the fault sites plus the failure-contract
+    rows."""
+    streaming_md = DOCS / "STREAMING.md"
+    assert streaming_md.exists()
+    text = streaming_md.read_text()
+    for token in ("StreamSpec", "horizon", "APPEND", "horizon_pending",
+                  "horizon_advance", "stream_seq", "weights_delta",
+                  "stream_weights", "stream_batches",
+                  "capability_stream_batches", "streaming=True",
+                  "Advance under reshard", "streaming-smoke"):
+        assert token in text, f"docs/STREAMING.md lost `{token}`"
+    for doc in ("SERVICE.md", "RESILIENCE.md", "CAPABILITY.md", "API.md"):
+        assert "STREAMING.md" in (DOCS / doc).read_text(), (
+            f"docs/{doc} lost its cross-link to docs/STREAMING.md")
+    assert "docs/STREAMING.md" in (DOCS.parent / "README.md").read_text()
+    svc = (DOCS / "SERVICE.md").read_text()
+    assert "## Streaming mode" in svc, (
+        "docs/SERVICE.md lost its Streaming mode section")
+    assert "APPEND" in svc, "docs/SERVICE.md lost the `APPEND` frame"
+    api = API_MD.read_text()
+    for token in ("StreamSpec", "streaming=False", "horizon=None",
+                  "attach=False", "stream_batches", "eligible_horizons",
+                  "with_stream_weights"):
+        assert token in api, f"docs/API.md lost the streaming surface `{token}`"
+    obs = OBSERVABILITY_MD.read_text()
+    for token in ("stream_appends", "horizon_advances",
+                  "stream_gc_truncations", "horizon_advance_ms",
+                  "append_visible_ms", "stream_waits"):
+        assert token in obs, (
+            f"docs/OBSERVABILITY.md lost the streaming metric `{token}`")
+    # the documented fault sites must be the registered ones
+    from partiallyshuffledistributedsampler_tpu import faults as F
+
+    res = (DOCS / "RESILIENCE.md").read_text()
+    for site in ("stream.append", "stream.advance"):
         assert site in F.SITES and site in res
 
 

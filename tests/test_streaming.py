@@ -1,0 +1,644 @@
+"""Epochless moving-horizon streaming (docs/STREAMING.md).
+
+The contract under test: the index space is append-only and the shuffle
+never sees an "epoch end" — the stream is cut into horizons of H
+samples, horizon generation ``g`` IS epoch ``g`` everywhere in the
+framework, a horizon advance is an ack-gated lightweight barrier (not a
+reshard), and the exactly-once law extends to the unbounded stream:
+appends landing mid-serve, injected append/advance faults, a mid-stream
+elastic reshard and a primary kill at the advance barrier must all
+leave the union of every rank's delivered indices equal to the eligible
+samples, each exactly once — while server + WAL state stays O(horizon),
+not O(stream).
+
+These run inside tier-1 and are the first leg of the
+``make streaming-smoke`` gate (``-m streaming``).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import Counter
+
+import numpy as np
+import pytest
+
+from partiallyshuffledistributedsampler_tpu import faults as F
+from partiallyshuffledistributedsampler_tpu.durability.recover import (
+    recover_unstarted,
+)
+from partiallyshuffledistributedsampler_tpu.ops.mixture import MixtureSpec
+from partiallyshuffledistributedsampler_tpu.sampler.host_loader import (
+    HostDataLoader,
+)
+from partiallyshuffledistributedsampler_tpu.sampler.jax_iterator import (
+    DeviceEpochIterator,
+)
+from partiallyshuffledistributedsampler_tpu.service import (
+    IndexServer,
+    ServiceError,
+    ServiceIndexClient,
+)
+from partiallyshuffledistributedsampler_tpu.streaming import StreamSpec
+from partiallyshuffledistributedsampler_tpu.streaming.spec import (
+    WEIGHTS_RETAIN,
+)
+
+from test_failover import replicated_pair, wait_for, wait_synced
+
+pytestmark = pytest.mark.streaming
+
+SECRET = b"psds-test-deployment-secret"
+
+H = 64  #: default horizon extent for service-level tests
+
+
+def plain_stream(world=2, horizon=H, **kw):
+    kw.setdefault("window", 8)
+    kw.setdefault("seed", 7)
+    return StreamSpec.plain_stream(horizon, world=world, **kw)
+
+
+def mixture_stream(world=2, horizon=96, **kw):
+    kw.setdefault("seed", 7)
+    ms = MixtureSpec([100, 200, 50], [5, 3, 2], block=16)
+    return StreamSpec.mixture_stream(horizon, mixture=ms, world=world, **kw)
+
+
+def feed(address, count, *, weights_delta=None):
+    """One-shot feeder: extend the stream by ``count`` samples."""
+    c = ServiceIndexClient(address, rank=None, batch=4, attach=True,
+                           backoff_base=0.01, reconnect_timeout=10.0)
+    try:
+        return c.append(count, weights_delta=weights_delta)
+    finally:
+        c.close()
+
+
+def stream_union(delivered):
+    return Counter(
+        np.concatenate(
+            [a for got in delivered.values() for a in got]).tolist())
+
+
+# ------------------------------------------------------------ spec laws
+def test_stream_spec_laws():
+    """Eligibility, per-horizon union/offset, constant partition sizes
+    and wire-identity — the laws the module docstring states."""
+    spec = plain_stream(world=2)
+    # eligibility: whole horizons only
+    assert spec.eligible_horizons(0) == 0
+    assert spec.eligible_horizons(H - 1) == 0
+    assert spec.eligible_horizons(H) == 1
+    assert spec.eligible_horizons(3 * H + 1) == 3
+    # per-horizon union: exactly the absolute block [gH, (g+1)H)
+    perms = []
+    for g in range(3):
+        per_rank = [np.asarray(spec.rank_indices(g, r)) for r in range(2)]
+        union = np.sort(np.concatenate(per_rank))
+        assert np.array_equal(union, np.arange(g * H, (g + 1) * H)), g
+        perms.append(np.concatenate(per_rank) - g * H)
+    # the epoch already perturbs the permutation: horizons differ
+    assert not np.array_equal(perms[0], perms[1])
+    assert not np.array_equal(perms[1], perms[2])
+    # partition sizes are constant across horizons (advance-barrier math)
+    assert spec.num_samples(0) == spec.num_samples(1) == H // 2
+    # wire round-trip preserves the stream identity
+    back = StreamSpec.from_wire(spec.to_wire())
+    assert back.fingerprint() == spec.fingerprint()
+    assert back.mode == "stream" and back.horizon == H
+    assert np.array_equal(back.rank_indices(2, 1), spec.rank_indices(2, 1))
+
+
+def test_stream_spec_builder_refusals():
+    with pytest.raises(ValueError):
+        StreamSpec.plain_stream(0, window=8)
+    with pytest.raises(ValueError):
+        StreamSpec(horizon=H)  # no base at all
+    with pytest.raises(ValueError):
+        # per-source windows ride the mixture key
+        StreamSpec(horizon=H, window=8,
+                   mixture=MixtureSpec([100, 50], [1, 1], block=10))
+
+
+def test_stream_weights_adoption_and_prune():
+    """Per-horizon re-weighting: newest-at-or-below lookup, identity
+    stable under adoption, pruning keeps the anchor entry."""
+    spec = mixture_stream(world=1)
+    base = tuple(int(x) for x in spec.mixture_key[1])
+    assert spec.weights_for(0) == base
+    w2 = (8, 3, 2)
+    spec2 = spec.with_stream_weights({2: w2})
+    # the stream identity (fingerprint) is stable under re-weighting
+    assert spec2.fingerprint() == spec.fingerprint()
+    assert spec2.weights_for(0) == base and spec2.weights_for(1) == base
+    assert spec2.weights_for(2) == w2 and spec2.weights_for(9) == w2
+    # the re-weighted horizon's stream actually moves
+    assert not np.array_equal(spec2.rank_indices(2, 0),
+                              spec.rank_indices(2, 0))
+    assert np.array_equal(spec2.rank_indices(1, 0), spec.rank_indices(1, 0))
+    # pruning drops old entries but keeps the newest below the floor:
+    # it still anchors weights_for() for every retained horizon
+    spec3 = spec2.with_stream_weights({7: (1, 9, 1)}, prune_below=5)
+    assert spec3.weights_for(4) == w2  # anchored by the pruned-survivor
+    assert spec3.weights_for(7) == (1, 9, 1)
+    assert set(spec3.stream_weights) == {2, 7}
+    spec4 = spec3.with_stream_weights({}, prune_below=100)
+    assert set(spec4.stream_weights) == {7}
+    assert spec4.weights_for(100) == (1, 9, 1)
+    # a plain stream has nothing to weight
+    assert plain_stream().weights_for(3) is None
+
+
+# ---------------------------------------------------- append + eligibility
+def test_append_idempotent_and_eligibility_gate():
+    """An APPEND replay is answered ``duplicate`` without re-counting,
+    and a horizon is refused (typed, retryable) until fully appended."""
+    spec = plain_stream(world=1)
+    with IndexServer(spec) as srv:
+        c = ServiceIndexClient(srv.address, rank=None, batch=4, attach=True,
+                               backoff_base=0.01, reconnect_timeout=10.0)
+        try:
+            out = c.append(H // 2)
+            assert out["appended"] == H // 2 and out["eligible"] == 0
+            # a half-appended horizon is not servable: the typed refusal
+            # paces the client until its deadline
+            w = ServiceIndexClient(srv.address, rank=0, batch=16,
+                                   backoff_base=0.01, reconnect_timeout=0.6)
+            try:
+                with pytest.raises(ServiceError) as ei:
+                    next(iter(w.epoch_batches(0)))
+                assert ei.value.code == "horizon_pending"
+                assert w.metrics.report()["counters"]["stream_waits"] >= 1
+            finally:
+                w.close()
+            out = c.append(H // 2)
+            assert out["appended"] == H and out["eligible"] == 1
+            with ServiceIndexClient(srv.address, rank=0, batch=16,
+                                    backoff_base=0.01,
+                                    reconnect_timeout=10.0) as w:
+                got = np.concatenate(list(w.epoch_batches(0)))
+            assert np.array_equal(got, spec.rank_indices(0, 0))
+        finally:
+            c.close()
+        counters = srv.metrics.report()["counters"]
+        assert counters.get("stream_appends", 0) == 2
+
+
+def test_append_while_serving_exactly_once():
+    """The core law: appends land mid-serve, ranks ride the typed
+    backpressure, the advance barrier folds horizons 0->1->2, and the
+    union of all delivered indices is every appended sample exactly
+    once."""
+    spec = plain_stream(world=2)
+    delivered = {}
+    lock = threading.Lock()
+    with IndexServer(spec) as srv:
+        addr = srv.address
+
+        def feeder():
+            c = ServiceIndexClient(addr, rank=None, batch=4, attach=True,
+                                   backoff_base=0.01, reconnect_timeout=10.0)
+            try:
+                for _ in range(6):
+                    c.append(32)
+                    time.sleep(0.02)
+            finally:
+                c.close()
+
+        def worker(r):
+            c = ServiceIndexClient(addr, rank=r, batch=16,
+                                   backoff_base=0.01, reconnect_timeout=10.0)
+            got = []
+            try:
+                for arr in c.stream_batches(horizons=3):
+                    got.append(np.asarray(arr))
+            finally:
+                with lock:
+                    delivered[r] = got
+                c.close()
+
+        ths = [threading.Thread(target=worker, args=(r,)) for r in range(2)]
+        for t in ths:
+            t.start()
+        time.sleep(0.05)
+        ft = threading.Thread(target=feeder)
+        ft.start()
+        ft.join(30)
+        for t in ths:
+            t.join(30)
+        assert not any(t.is_alive() for t in ths), "worker hung"
+        assert srv.epoch == 2
+        counters = srv.metrics.report()["counters"]
+        hists = srv.metrics.report()["histograms"]
+        assert counters.get("stream_appends", 0) == 6
+        assert counters.get("horizon_advances", 0) == 2
+        assert "horizon_advance_ms" in hists
+        assert "append_visible_ms" in hists
+    assert stream_union(delivered) == Counter(range(3 * H))
+
+
+# --------------------------------------------------- mixture re-weighting
+def test_reweight_and_capability_arm_bit_identical():
+    """Online mixture re-weighting: a ``weights_delta`` riding an APPEND
+    folds in at the next advance, moves the stream, and the signed
+    capability carries the horizon's effective weights — the on-device
+    regen arm is bit-identical to the served-batch arm."""
+    served = {}
+    regen = {}
+    for arm, sink in (("served", served), ("capability", regen)):
+        spec = mixture_stream(world=2)
+        hz = spec.horizon
+        with IndexServer(spec, capability_secret=SECRET) as srv:
+            addr = srv.address
+            feed(addr, hz)
+            # the delta and the eligibility extension land atomically:
+            # the advance into horizon 1 MUST see the folded weights
+            feed(addr, hz, weights_delta=[3, 0, 0])
+            feed(addr, hz)
+            errors = []
+
+            def worker(r):
+                kw = dict(backoff_base=0.01, reconnect_timeout=20.0)
+                if arm == "capability":
+                    kw["capability_secret"] = SECRET
+                c = ServiceIndexClient(addr, rank=r, batch=16,
+                                       spec=mixture_stream(world=2), **kw)
+                got = []
+                try:
+                    it = (c.capability_stream_batches(horizons=3)
+                          if arm == "capability"
+                          else c.stream_batches(horizons=3))
+                    for arr in it:
+                        got.append(np.asarray(arr))
+                except Exception as exc:  # surfaced after join
+                    errors.append((arm, r, exc))
+                finally:
+                    sink[r] = got
+                    c.close()
+
+            ths = [threading.Thread(target=worker, args=(r,))
+                   for r in range(2)]
+            for t in ths:
+                t.start()
+            for t in ths:
+                t.join(30)
+            assert not errors, errors
+            assert srv.epoch == 2
+            # the adopted weights live on the server's spec now
+            assert srv.spec.weights_for(1) == (8, 3, 2)
+    for r in range(2):
+        a = np.concatenate(served[r])
+        b = np.concatenate(regen[r])
+        assert np.array_equal(a, b), f"capability arm diverged for rank {r}"
+    # the re-weighted horizon genuinely moved vs. the base weights, and
+    # matches the spec-level law for (5,3,2) + (3,0,0)
+    base = mixture_stream(world=2)
+    ref = base.with_stream_weights({1: (8, 3, 2)})
+    for r in range(2):
+        per_h = np.split(np.concatenate(served[r]), 3)
+        assert not np.array_equal(per_h[1], base.rank_indices(1, r))
+        assert np.array_equal(per_h[1], ref.rank_indices(1, r))
+        assert np.array_equal(per_h[0], base.rank_indices(0, r))
+        assert np.array_equal(per_h[2], ref.rank_indices(2, r))
+
+
+# ------------------------------------------------------------ chaos matrix
+@pytest.mark.chaos
+def test_chaos_append_fault_never_skips_or_double_counts():
+    """An APPEND lost before the WAL write (refusal or handler death)
+    is replayed by the feeder's ``(feeder, stream_seq)`` retry and lands
+    exactly once — the served stream neither skips nor double-serves."""
+    for kind in ("error", "thread_death"):
+        spec = plain_stream(world=1, horizon=32)
+        with IndexServer(spec) as srv:
+            c = ServiceIndexClient(srv.address, rank=None, batch=4,
+                                   attach=True, backoff_base=0.01,
+                                   reconnect_timeout=10.0)
+            plan = F.FaultPlan([F.FaultRule(site="stream.append",
+                                            kind=kind, count=1)])
+            try:
+                with plan:
+                    out = c.append(32)
+                assert out["appended"] == 32 and not out.get("duplicate")
+                out = c.append(32)
+                assert out["appended"] == 64
+            finally:
+                c.close()
+            assert plan.fired("stream.append") == 1, \
+                "fault never fired; the test is vacuous"
+            with ServiceIndexClient(srv.address, rank=0, batch=8,
+                                    backoff_base=0.01,
+                                    reconnect_timeout=10.0) as w:
+                got = np.concatenate(list(w.stream_batches(horizons=2)))
+        assert Counter(got.tolist()) == Counter(range(64)), kind
+
+
+@pytest.mark.chaos
+def test_chaos_advance_abort_rolls_back_cleanly():
+    """An injected abort at the advance barrier (pre-mutation) is a
+    clean retryable refusal: the horizon generation does not move, the
+    client retries, and the stream stays exactly-once."""
+    spec = plain_stream(world=1, horizon=32)
+    with IndexServer(spec) as srv:
+        feed(srv.address, 64)
+        plan = F.FaultPlan([F.FaultRule(site="stream.advance",
+                                        kind="error", count=1)])
+        with plan:
+            with ServiceIndexClient(srv.address, rank=0, batch=8,
+                                    backoff_base=0.01,
+                                    reconnect_timeout=10.0) as w:
+                got = np.concatenate(list(w.stream_batches(horizons=2)))
+        assert plan.fired("stream.advance") == 1, \
+            "fault never fired; the test is vacuous"
+        assert srv.epoch == 1
+        assert srv.metrics.report()["counters"]["horizon_advances"] == 1
+    assert Counter(got.tolist()) == Counter(range(64))
+
+
+# ------------------------------------------------------- mid-stream reshard
+@pytest.mark.elastic
+def test_mid_stream_reshard_union_exactly_once():
+    """One elastic reshard (2 -> 3) lands mid-horizon-1 while appends
+    are already in: the frozen remainder is re-dealt, the joiner picks
+    up its share, the advance barrier re-pins per-rank targets under
+    the new partition and still commits, and the union law holds over
+    the whole stream (wrap-pad extras only)."""
+    spec = plain_stream(world=2)
+    delivered = {}
+    lock = threading.Lock()
+    with IndexServer(spec) as srv:
+        addr = srv.address
+        feed(addr, 3 * H)  # deterministic serve side
+        # RESHARD rides its own attach connection: a control RPC on a
+        # worker's pipelined connection would race its in-flight replies
+        ctl = ServiceIndexClient(addr, rank=None, batch=4, attach=True,
+                                 backoff_base=0.01, reconnect_timeout=10.0)
+        b_hit = threading.Barrier(3)
+
+        def worker(r):
+            c = ServiceIndexClient(addr, rank=r, batch=8,
+                                   backoff_base=0.01, reconnect_timeout=10.0)
+            got = []
+            try:
+                it = c.stream_batches(horizons=3)
+                # horizon 0 fully, then partway into horizon 1
+                for _ in range(H // 2 // 8 + 2):
+                    got.append(np.asarray(next(it)))
+                b_hit.wait(timeout=30)
+                # keep consuming: the freeze barrier commits only once
+                # every rank drains to its consumption watermark
+                for arr in it:
+                    got.append(np.asarray(arr))
+            finally:
+                with lock:
+                    delivered[r] = got
+                c.close()
+
+        def joiner():
+            c = ServiceIndexClient(addr, rank=None, batch=8,
+                                   backoff_base=0.01, reconnect_timeout=10.0)
+            got = []
+            try:
+                # the new rank picks up its re-dealt share of horizon 1,
+                # then rides horizon 2 to the stream end
+                for arr in c.stream_batches(start_horizon=1, horizons=2):
+                    got.append(np.asarray(arr))
+            finally:
+                with lock:
+                    delivered["j"] = got
+                c.close()
+
+        ths = [threading.Thread(target=worker, args=(r,)) for r in range(2)]
+        for t in ths:
+            t.start()
+        try:
+            b_hit.wait(timeout=30)
+            ctl.reshard(3)
+            wait_for(lambda: srv.generation == 1, timeout=20.0)
+            jt = threading.Thread(target=joiner)
+            jt.start()
+            for t in ths:
+                t.join(30)
+            jt.join(30)
+            assert not any(t.is_alive() for t in ths + [jt]), "worker hung"
+        finally:
+            ctl.close()
+        assert srv.epoch == 2, f"advance deadlocked at epoch {srv.epoch}"
+        assert srv.spec.world == 3
+    union = stream_union(delivered)
+    full = Counter(range(3 * H))
+    missing = full - union
+    assert not missing, f"dropped: {sorted(missing)[:8]}"
+    extras = union - full
+    assert sum(extras.values()) <= 3, f"too many wrap-pad extras: {extras}"
+    assert set(extras) <= set(full)
+
+
+# -------------------------------------------------------- bounded state
+@pytest.mark.durability
+def test_watermark_gc_keeps_state_o_horizon(tmp_path):
+    """The bounded-state guarantee: while appended samples grow without
+    bound across >= 10 advances, every advance seals a forced checkpoint
+    and the WAL GC truncates below the watermark — segment count and
+    server cursor state stay O(horizon), not O(stream)."""
+    hz, horizons = 32, 12
+    spec = plain_stream(world=1, horizon=hz)
+    snap = str(tmp_path / "snap.json")
+    wal_dir = str(tmp_path / "wal")
+    srv = IndexServer(spec, port=0, snapshot_path=snap, wal_dir=wal_dir,
+                      fsync="off")
+    host, port = srv.start()
+    try:
+        # tiny segments so rotation (and therefore GC) actually happens
+        srv._wal.segment_bytes = 512
+        done = threading.Event()
+
+        def feeder():
+            c = ServiceIndexClient((host, port), rank=None, batch=4,
+                                   attach=True, backoff_base=0.01,
+                                   reconnect_timeout=20.0)
+            try:
+                for _ in range(horizons * hz // 8):
+                    c.append(8)
+                    time.sleep(0.002)
+            finally:
+                done.set()
+                c.close()
+
+        ft = threading.Thread(target=feeder)
+        ft.start()
+        with ServiceIndexClient((host, port), rank=0, batch=16,
+                                backoff_base=0.01,
+                                reconnect_timeout=30.0) as w:
+            got = np.concatenate(list(w.stream_batches(horizons=horizons)))
+        ft.join(30)
+        assert done.is_set()
+        assert Counter(got.tolist()) == Counter(range(horizons * hz))
+        assert srv.epoch == horizons - 1
+        counters = srv.metrics.report()["counters"]
+        assert counters.get("horizon_advances", 0) == horizons - 1
+        assert counters.get("stream_gc_truncations", 0) >= 1, \
+            "advances never truncated the WAL"
+        # O(horizon), not O(stream): the live tail is bounded while the
+        # record history (48 appends + every cursor ack) was not
+        assert len(srv._wal.segment_paths()) <= 6
+        assert json.load(open(snap)).get("wal_lsn", 0) > 0
+        # cursor state is O(world), append dedup state O(feeders)
+        assert len(srv._cursors) == 1
+        assert len(srv._stream_seqs) == 1
+    finally:
+        srv.stop()
+
+
+@pytest.mark.durability
+def test_mid_stream_crash_recovery_bit_identical(tmp_path):
+    """A daemon killed mid-stream recovers from checkpoint + tail replay
+    and resumes at the exact horizon generation and ack watermark: the
+    full delivered stream across the crash is bit-identical to the
+    spec's."""
+    hz = 32
+    spec = plain_stream(world=1, horizon=hz)
+    snap = str(tmp_path / "snap.json")
+    wal_dir = str(tmp_path / "wal")
+    srv = IndexServer(spec, port=0, snapshot_path=snap, wal_dir=wal_dir,
+                      fsync="off")
+    host, port = srv.start()
+    feed((host, port), 4 * hz)
+    with ServiceIndexClient((host, port), rank=0, batch=8,
+                            backoff_base=0.01, reconnect_timeout=10.0) as w:
+        before = np.concatenate(list(w.stream_batches(horizons=2)))
+    assert srv.epoch == 1
+    srv.kill()  # no graceful snapshot: recovery rides checkpoint + tail
+    fresh = IndexServer(plain_stream(world=1, horizon=hz),
+                        snapshot_path=snap, wal_dir=wal_dir, fsync="off")
+    stats = recover_unstarted(fresh)
+    assert stats is not None
+    assert fresh.epoch == 1, "recovery lost the horizon generation"
+    assert fresh._stream_appended == 4 * hz, "recovery lost appends"
+    host, port = fresh.start()
+    try:
+        with ServiceIndexClient((host, port), rank=0, batch=8,
+                                backoff_base=0.01,
+                                reconnect_timeout=10.0) as w:
+            after = np.concatenate(list(
+                w.stream_batches(start_horizon=2, horizons=2)))
+        assert fresh.epoch == 3
+    finally:
+        fresh.stop()
+    ref = np.concatenate([np.asarray(spec.rank_indices(g, 0))
+                          for g in range(4)])
+    assert np.array_equal(np.concatenate([before, after]), ref)
+
+
+# ------------------------------------------------------------- failover
+@pytest.mark.failover
+def test_failover_finishes_advance_at_barrier():
+    """Kill the primary AT the advance barrier: every rank has acked
+    horizon 0 and is about to name horizon 1.  The promoted standby owns
+    the replicated ack cursors, passes the straggler gate, survives an
+    injected handler death mid-advance, and commits the advance — the
+    folded per-rank streams are bit-identical to the spec's."""
+    spec = plain_stream(world=2, horizon=32)
+    primary, standby = replicated_pair(spec)
+    delivered = {}
+    errors = []
+    lock = threading.Lock()
+    b_done0 = threading.Barrier(3)
+    b_go1 = threading.Barrier(3)
+
+    def worker(r):
+        c = ServiceIndexClient(primary.address, rank=r, batch=8,
+                               backoff_base=0.01, reconnect_timeout=20.0)
+        got = []
+        try:
+            for arr in c.epoch_batches(0):
+                got.append(np.asarray(arr))
+            b_done0.wait(timeout=30)
+            b_go1.wait(timeout=30)
+            # the first request naming horizon 1 IS the advance barrier
+            for arr in c.epoch_batches(1):
+                got.append(np.asarray(arr))
+        except Exception as exc:
+            errors.append((r, exc))
+        finally:
+            with lock:
+                delivered[r] = got
+            c.close()
+
+    plan = F.FaultPlan([F.FaultRule(site="stream.advance",
+                                    kind="thread_death", count=1)])
+    ths = [threading.Thread(target=worker, args=(r,)) for r in range(2)]
+    try:
+        feed(primary.address, 64)
+        for t in ths:
+            t.start()
+        b_done0.wait(timeout=30)
+        # every h0 ack (and the pinned per-rank totals) must be on the
+        # standby before the primary dies, or the gate would stall
+        wait_synced(primary, standby)
+        with plan:
+            primary.kill()
+            b_go1.wait(timeout=30)
+            for t in ths:
+                t.join(30)
+        assert not any(t.is_alive() for t in ths), "worker hung"
+        assert not errors, errors
+        assert plan.fired("stream.advance") >= 1, \
+            "fault never fired; the test is vacuous"
+        assert standby.role == "primary"
+        assert standby.epoch == 1, "promoted standby never advanced"
+        counters = standby.metrics.report()["counters"]
+        assert counters.get("horizon_advances", 0) >= 1
+    finally:
+        primary.kill()
+        standby.stop()
+    for r in range(2):
+        ref = np.concatenate([np.asarray(spec.rank_indices(g, r))
+                              for g in range(2)])
+        assert np.array_equal(np.concatenate(delivered[r]), ref), r
+
+
+# ------------------------------------------------------- loader/iterator
+def test_loader_streaming_units():
+    """``HostDataLoader(streaming=True)``: per-horizon indices are the
+    stream spec's absolute block, and a horizon-generation bump is an
+    epoch boundary for the index cache."""
+    data = np.arange(256)
+    ld = HostDataLoader(data, streaming=True, horizon=64, window=8,
+                        batch=16, rank=0, world=1)
+    assert ld.stream_spec.mode == "stream"
+    for g in range(3):
+        idx = ld.epoch_indices(g)
+        assert idx.min() >= g * 64 and idx.max() < (g + 1) * 64
+        assert np.array_equal(np.sort(idx), np.arange(g * 64, (g + 1) * 64))
+        assert np.array_equal(idx, ld.stream_spec.rank_indices(g, 0))
+    # one-entry cache within a horizon, dropped on the generation bump
+    a = ld.epoch_indices(1)
+    assert ld.epoch_indices(1) is a
+    ld.epoch_indices(2)
+    assert ld._stream_gen == 2
+    assert ld._idx_cache[0][0] == 2
+    # builder refusals
+    with pytest.raises(ValueError):
+        HostDataLoader(data, streaming=True, window=8, batch=16)
+    with pytest.raises(ValueError):
+        HostDataLoader(data, horizon=64, window=8, batch=16)
+
+
+def test_device_iterator_prunes_stale_horizons():
+    """A horizon-generation bump is an epoch boundary for the device
+    iterator too: cache and prefetch-ring entries below the generation
+    being served are dropped, never served stale."""
+    it = DeviceEpochIterator(n=64, window=8, batch=16, seed=3)
+    first = [np.asarray(b) for b in it.epoch(0)]
+    assert sum(len(b) for b in first) == 64
+    # epoch(0) prefetches epoch 1; jumping to 2 must drop everything
+    # below it (a moving-horizon stream only advances)
+    assert 1 in it._cache
+    second = [np.asarray(b) for b in it.epoch(2)]
+    assert sum(len(b) for b in second) == 64
+    assert all(k >= 2 for k in it._cache), sorted(it._cache)
+    assert all(k >= 2 for k in it._ring), sorted(it._ring)
