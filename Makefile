@@ -70,6 +70,12 @@
 #                          exactly-once, online re-weighting, bounded
 #                          WAL state, advance-barrier failover, then
 #                          the streaming-within-frozen-noise bar
+#   * autopilot smoke      tests/test_autopilot.py (`-m autopilot`)
+#                          + benchmarks/autopilot_smoke.py — closed-loop
+#                          self-tuning: knob-arm convergence on BASELINE
+#                          shapes, the controller-driven split drill
+#                          (streams bit-identical), then the calm-
+#                          controller idle-overhead-within-noise bar
 #   * analyze              project-native static analysis (docs/ANALYSIS.md):
 #                          guarded-by discipline, fault-site/protocol/
 #                          metrics-docs drift, clock discipline, silent-
@@ -84,7 +90,7 @@ PY ?= python
 .PHONY: check test bench native dryrun service-smoke chaos-smoke \
 	elastic-smoke telemetry-smoke failover-smoke tenancy-smoke \
 	durability-smoke fused-smoke sharding-smoke capability-smoke \
-	streaming-smoke analyze analysis-smoke
+	streaming-smoke autopilot-smoke analyze analysis-smoke
 
 # the driver parses the LAST line of bench.py's combined output (round 3
 # lost its headline to the details line — BENCH_r03.json "parsed": null),
@@ -189,6 +195,14 @@ capability-smoke:
 streaming-smoke:
 	$(PY) -m pytest tests/test_streaming.py -q -m streaming -ra
 	$(PY) benchmarks/streaming_smoke.py
+
+# autopilot gate (docs/AUTOPILOT.md): policy determinism/convergence,
+# elastic split/merge/migrate bit-identity, WAL-replayed controller
+# state, chaos per new fault site, then the convergence + split-drill
+# + idle-overhead-within-noise bars
+autopilot-smoke:
+	$(PY) -m pytest tests/test_autopilot.py -q -m autopilot -ra
+	$(PY) benchmarks/autopilot_smoke.py
 
 # static-analysis gate (docs/ANALYSIS.md): every lint pass over the
 # package + docs; any finding is a non-zero exit with file:line output

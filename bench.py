@@ -495,6 +495,21 @@ def main() -> None:
         except Exception as exc:
             details["streaming_error"] = repr(exc)[:200]
 
+    # detail tier: autopilot — knob-arm convergence on the BASELINE
+    # workload shapes, the controller-driven split drill (bit-identity
+    # hard-asserted inside), and the calm-controller idle-overhead bar
+    # (methodology in benchmarks/autopilot_smoke.py)
+    if not smoke:
+        try:
+            sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+            from benchmarks.autopilot_smoke import (
+                summarize as autopilot_summarize,
+            )
+
+            details["autopilot"] = autopilot_summarize()
+        except Exception as exc:
+            details["autopilot_error"] = repr(exc)[:200]
+
     # detail tier: analysis — concurrency-sanitizer overhead: the
     # tracked-lock arm must stay within the raw-lock arm's rep noise
     # and record zero lock-order cycles (methodology in

@@ -1,0 +1,254 @@
+"""Autopilot smoke: convergence, split recovery, and a free idle loop.
+
+Two consumers:
+
+* ``make autopilot-smoke`` / ``python benchmarks/autopilot_smoke.py``
+  — the CI gate: (a) the knob arm must converge the transport batch to
+  the target-RPC-rate band on two BASELINE workload shapes, landing
+  within a few percent of the analytic fixpoint; (b) a controller-
+  driven split under a hotspot must happen with no operator action and
+  leave every rank's stream bit-identical; (c) an attached-but-calm
+  controller must disappear into the bare server's own rep-to-rep
+  serve noise (the zero-cost law, measured rather than asserted).
+  Exit 0 and one JSON line on success; raises loudly otherwise.
+
+* ``bench.py`` imports :func:`summarize` for ``details["autopilot"]``.
+
+Methodology: convergence drives the deterministic policy alone under a
+fake clock (same observe→decide→adopt loop the controller runs; the
+policy is the thing that converges, and simulation makes the measure
+machine-independent).  The split drill runs a real ``ShardPlane`` with
+a real ``Autopilot``: only shard 0's ranks stream, the controller
+observes the skew and splits, and the next epoch is folded against a
+static single ``IndexServer``.  The idle-overhead arm serves the same
+epochs with and without a (calm) controller ticking between them; the
+autopiloted arm must land within the bare arm's noise band
+(docs/AUTOPILOT.md "Disabled means free").
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+#: convergence must land within this of the analytic fixpoint batch
+_MAX_CONVERGENCE_PCT = 5.0
+
+#: BASELINE.json workload shapes the convergence arm replays:
+#: (label, sustained samples/s, starting client batch)
+_WORKLOADS = (
+    # "CIFAR-10 torchvision DDP, window=512, 2 ranks (CPU reference)"
+    ("cifar10_w512_2ranks", 50_000.0, 512),
+    # "ImageNet-1k ResNet-50 DDP, window=8192, 8 TPU v4 chips"
+    ("imagenet_w8192_8chips", 160_000.0, 1024),
+)
+
+
+class _FakeClock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+
+def _converge(throughput: float, batch0: int, *, ticks: int = 32) -> dict:
+    """Replay the observe→decide→adopt loop against a simulated
+    workload; return the fixpoint error vs the analytic target."""
+    from partiallyshuffledistributedsampler_tpu.autopilot import (
+        AutopilotPolicy,
+        PolicyConfig,
+    )
+
+    cfg = PolicyConfig(min_batch=256)
+    clock = _FakeClock()
+    policy = AutopilotPolicy(cfg, clock=clock)
+    batch, settle_tick = batch0, 0
+    for i in range(ticks):
+        clock.t += 1.0
+        obs = {"now": clock(), "window_s": 1.0,
+               "served": max(1, int(throughput / batch)),
+               "throttled": 0, "batch": batch}
+        for d in policy.decide(obs):
+            if d.kind == "tune" and "batch_hint" in d.args:
+                batch = int(d.args["batch_hint"])
+                settle_tick = i + 1
+    # the analytic fixpoint: the first doubling of batch0 whose RPC
+    # rate drops to the target band (what the doubling ladder can reach)
+    ideal = batch0
+    while throughput / ideal > cfg.target_rpc_per_s \
+            and ideal < cfg.max_batch:
+        ideal *= 2
+    pct_off = abs(batch - ideal) / ideal * 100.0
+    rate = throughput / batch
+    return {
+        "batch0": batch0, "batch_final": batch, "batch_ideal": ideal,
+        "ticks_to_settle": settle_tick,
+        "final_rpc_per_s": round(rate, 2),
+        "pct_off_fixpoint": round(pct_off, 2),
+        "converged": bool(pct_off <= _MAX_CONVERGENCE_PCT
+                          and rate <= cfg.target_rpc_per_s),
+    }
+
+
+def _split_drill(n: int, window: int) -> dict:
+    """A real plane, a real controller, a real hotspot: the controller
+    must split shard 0 with no operator call, streams bit-identical."""
+    from partiallyshuffledistributedsampler_tpu.autopilot import (
+        Autopilot,
+        PolicyConfig,
+    )
+    from partiallyshuffledistributedsampler_tpu.service import (
+        IndexServer,
+        PartialShuffleSpec,
+        ServiceIndexClient,
+    )
+    from partiallyshuffledistributedsampler_tpu.sharding import ShardPlane
+
+    world = 8
+    spec = PartialShuffleSpec.plain(n, window=window, world=world)
+
+    def epoch(addr, rank, e):
+        with ServiceIndexClient(addr, rank=rank, batch=256, spec=spec,
+                                backoff_base=0.01) as c:
+            if rank == 0:
+                c.set_epoch(e)
+            return np.concatenate(list(c.epoch_batches(e)))
+
+    ref = {}
+    with IndexServer(spec) as srv:
+        for e in (0, 1):
+            for r in range(world):
+                ref[(e, r)] = epoch(srv.address, r, e)
+
+    clock = _FakeClock()
+    with ShardPlane(spec, 2) as plane:
+        ap = Autopilot(
+            plane=plane, clock=clock,
+            config=PolicyConfig(hot_factor=1.5, split_p99_ms=0.0,
+                                struct_cooldown_s=0.0,
+                                target_rpc_per_s=1e9))
+        clock.t += 1.0
+        ap.tick()                       # baseline window
+        t0 = time.perf_counter()
+        for r in range(4):              # the hotspot: shard 0's ranks only
+            if not np.array_equal(epoch(plane.address, r, 0), ref[(0, r)]):
+                raise AssertionError(f"pre-split stream diverged, rank {r}")
+        hot_wall_ms = (time.perf_counter() - t0) * 1e3
+        clock.t += 1.0
+        kinds = [d.kind for d in ap.tick()]
+        if "split" not in kinds:
+            raise AssertionError(
+                f"controller never split under the hotspot ({kinds})")
+        t0 = time.perf_counter()
+        for r in range(4):
+            if not np.array_equal(epoch(plane.address, r, 1), ref[(1, r)]):
+                raise AssertionError(f"post-split stream diverged, rank {r}")
+        split_wall_ms = (time.perf_counter() - t0) * 1e3
+        for r in range(4, world):       # cold ranks: identical too
+            if not np.array_equal(epoch(plane.address, r, 1), ref[(1, r)]):
+                raise AssertionError(f"post-split stream diverged, rank {r}")
+        counters = plane.shards[0].metrics.registry.report()["counters"]
+        rep = plane.router.metrics.report()["counters"]
+    return {
+        "n_shards_after": 3,
+        "hot_wall_ms": round(hot_wall_ms, 3),
+        "post_split_wall_ms": round(split_wall_ms, 3),
+        "autopilot_splits": int(counters.get("autopilot_splits", 0)),
+        "shard_migrations": int(rep.get("shard_migrations", 0)),
+        "bit_identical": True,          # hard-asserted above
+    }
+
+
+def _idle_overhead(n: int, window: int, epochs: int) -> dict:
+    """Serve the same epochs bare vs with a calm controller ticking
+    between them; the autopiloted arm must sit inside the bare arm's
+    own rep noise."""
+    from partiallyshuffledistributedsampler_tpu.autopilot import (
+        Autopilot,
+        PolicyConfig,
+    )
+    from partiallyshuffledistributedsampler_tpu.service import (
+        IndexServer,
+        PartialShuffleSpec,
+        ServiceIndexClient,
+    )
+
+    spec = PartialShuffleSpec.plain(n, window=window, world=1)
+    calm = PolicyConfig(target_rpc_per_s=1e12)   # observes, never acts
+    clock = _FakeClock()
+
+    # both arms interleave per epoch on live side-by-side daemons, so
+    # machine drift hits them equally (the sharding-smoke methodology)
+    bare_walls, piloted_walls = [], []
+    with IndexServer(spec) as bare_srv, IndexServer(spec) as ap_srv:
+        ap = Autopilot(server=ap_srv, clock=clock, config=calm)
+        with ServiceIndexClient(bare_srv.address, rank=0, batch=256,
+                                spec=spec, backoff_base=0.01) as cb, \
+                ServiceIndexClient(ap_srv.address, rank=0, batch=256,
+                                   spec=spec, backoff_base=0.01) as cp:
+            for e in range(epochs):
+                for c, walls in ((cb, bare_walls), (cp, piloted_walls)):
+                    t0 = time.perf_counter()
+                    total = sum(len(b) for b in c.epoch_batches(e))
+                    walls.append((time.perf_counter() - t0) * 1e3)
+                    assert total == n, (e, total)
+                clock.t += 1.0
+                ap.tick()
+
+    bare = sorted(bare_walls[1:])       # drop the compile/regen warmup
+    piloted = sorted(piloted_walls[1:])
+    bare_med = bare[len(bare) // 2]
+    piloted_med = piloted[len(piloted) // 2]
+    noise = max(bare) - min(bare)
+    return {
+        "bare_wall_ms_per_epoch": round(bare_med, 3),
+        "bare_noise_ms": round(noise, 3),
+        "autopiloted_wall_ms_per_epoch": round(piloted_med, 3),
+        "autopilot_within_noise": bool(
+            piloted_med <= bare_med + max(noise, 0.5)),
+    }
+
+
+def summarize(*, n: int = None, window: int = 256,
+              epochs: int = 6) -> dict:
+    """Convergence + split drill + idle overhead — the
+    ``details["autopilot"]`` tier."""
+    if n is None:
+        n = (8192 if os.environ.get("PSDS_BENCH_SMOKE") else 32768)
+    convergence = {label: _converge(rate, b0)
+                   for label, rate, b0 in _WORKLOADS}
+    return {
+        "n": n, "window": window, "epochs": epochs,
+        "convergence": convergence,
+        "knob_convergence_within_pct": bool(
+            all(c["converged"] for c in convergence.values())),
+        "split_drill": _split_drill(n, window),
+        **_idle_overhead(n, window, epochs),
+    }
+
+
+def main() -> None:
+    """The `make autopilot-smoke` gate: hard assertions, one JSON line."""
+    report = summarize()
+    for label, c in report["convergence"].items():
+        assert c["converged"], (
+            f"knob arm failed to converge on {label}: {c!r} "
+            f"(> {_MAX_CONVERGENCE_PCT}% off the fixpoint)")
+    assert report["split_drill"]["autopilot_splits"] == 1, report
+    assert report["autopilot_within_noise"], (
+        f"a calm controller fell out of the bare server's noise: "
+        f"{report['autopiloted_wall_ms_per_epoch']}ms vs "
+        f"{report['bare_wall_ms_per_epoch']}ms "
+        f"± {report['bare_noise_ms']}ms")
+    print(json.dumps({"autopilot_smoke": "ok", **report}))
+
+
+if __name__ == "__main__":
+    main()

@@ -72,11 +72,13 @@ _DOC_TOKEN_PASSTHROUGH = frozenset({
     "spec_fingerprint", "retry_ms", "grace_ms", "from_lsn",
     # typed error codes documented next to the counters they bump
     "tenant_admission", "spec_mismatch", "capability_unsupported",
-    "horizon_pending", "horizon_advance", "stream_append",
+    "horizon_pending", "horizon_advance", "stream_append", "wrong_shard",
     # streaming-mode kwarg/helper/wire vocabulary (docs/STREAMING.md)
     "capability_stream_batches", "stream_seq", "weights_delta",
     # capability-mode kwarg/helper/wire vocabulary (docs/CAPABILITY.md)
     "capability_heartbeat_s", "membership_stream", "target_samples",
+    # autopilot kwarg vocabulary (docs/AUTOPILOT.md)
+    "drill_interval_s", "batch_hint", "drill_max_lag_ms",
     # smoke-report fields the docs quote next to the metric tables
     "steady_noise_ms_per_step", "sanitize_overhead_within_noise",
 })

@@ -1,0 +1,274 @@
+"""The autopilot's deterministic policy engine (docs/AUTOPILOT.md).
+
+``AutopilotPolicy`` is a pure decision function over windowed
+observations: same state + same observation → same decisions, on every
+replay.  Nothing here reads a wall clock (``clock=`` is injected), draws
+randomness at decision time, or touches the deployment — the controller
+(:mod:`.controller`) observes and actuates; the policy only *decides*.
+That purity is what makes decisions WAL-replayable: a promoted standby
+loads the last logged ``state_dict()`` and continues the exact decision
+trajectory the dead primary was on.
+
+The rules are threshold/hysteresis arms, evaluated in a fixed order
+(knobs → shed → shard map → drill) so a tick's decision list is itself
+deterministic:
+
+* ``tune``: double/halve the advertised transport batch toward a target
+  RPC rate; widen ``max_inflight`` when the window saw throttle
+  refusals, narrow it back once the stream has been calm for a while.
+* ``shed``: scale every ``retry_ms`` hint (the typed-backpressure
+  table) up ×2 while refusals persist, decay ÷2 when calm.
+* ``split`` / ``merge`` / ``migrate``: compare per-shard served
+  volumes; a shard serving ``hot_factor``× the mean with a slow p99
+  splits, two rank-adjacent shards both under ``cold_factor``× merge,
+  and a hot/cold adjacent imbalance migrates a quarter of the hot
+  shard's ranks.  Structural moves share one cooldown.
+* ``drill``: when replication lag is clean and nothing structural
+  happened this tick, promote the standby to measure a real failover.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass(frozen=True)
+class PolicyConfig:
+    """Thresholds for every arm; defaults are deliberately calm."""
+
+    # -- knob arm: transport batch sizing toward a target RPC rate
+    target_rpc_per_s: float = 50.0   # fewer, larger batches above this
+    min_batch: int = 1024
+    max_batch: int = 1 << 20
+    # -- knob arm: in-flight window
+    min_inflight: int = 2
+    max_inflight: int = 64
+    calm_ticks_to_narrow: int = 8    # throttle-free ticks before narrowing
+    # -- shed arm
+    shed_threshold: int = 4          # throttle refusals/window that shed
+    max_shed_scale: float = 8.0
+    # -- shard-map arm
+    hot_factor: float = 2.0          # served > factor * mean → hot
+    cold_factor: float = 0.25        # served < factor * mean → cold
+    split_p99_ms: float = 20.0       # hot alone is not enough: p99 slow too
+    min_shard_ranks: int = 2         # never split below this many ranks
+    struct_cooldown_s: float = 5.0   # one structural move per cooldown
+    # -- drill arm (off by default: a drill IS a real failover)
+    drill_interval_s: Optional[float] = None
+    drill_max_lag_ms: float = 50.0
+    # -- backend arm (off by default: the cost probe is seconds-expensive)
+    backend_pick: bool = False
+
+
+@dataclass(frozen=True)
+class Decision:
+    """One actuation the policy asks the controller for."""
+
+    seq: int
+    kind: str            # tune | shed | split | merge | migrate | drill
+    #                    # | pick_backend
+    target: Optional[int] = None     # shard id for split
+    args: dict = field(default_factory=dict)
+    reason: str = ""
+
+
+class AutopilotPolicy:
+    """Deterministic threshold policy (see module doc)."""
+
+    def __init__(self, config: Optional[PolicyConfig] = None, *,
+                 clock=None, seed: int = 0) -> None:
+        self.config = config if config is not None else PolicyConfig()
+        if clock is None:
+            raise ValueError(
+                "AutopilotPolicy needs an injected clock= (monotonic "
+                "seconds); implicit wall clocks would make replay drift")
+        self._clock = clock
+        self.seed = int(seed)
+        self._s = {
+            "seq": 0,              # decisions emitted so far
+            "batch_hint": None,    # last tuned transport batch
+            "max_inflight": None,  # last tuned in-flight window
+            "scale": 1.0,          # current shed scale
+            "calm_ticks": 0,       # consecutive throttle-free ticks
+            "last_struct_t": None,  # clock at the last split/merge/migrate
+            "last_drill_t": None,
+            "backend": None,       # adopted regen backend
+        }
+
+    # ------------------------------------------------------------- replay
+    def state_dict(self) -> dict:
+        """JSON-safe decision state — what the ``autopilot`` WAL record
+        carries, and what a promoted standby's controller loads."""
+        return dict(self._s, seed=self.seed)
+
+    def load_state_dict(self, d: dict) -> None:
+        d = dict(d or {})
+        self.seed = int(d.pop("seed", self.seed))
+        for k in self._s:
+            if k in d:
+                self._s[k] = d[k]
+
+    # ------------------------------------------------------------- decide
+    def decide(self, obs: dict) -> list:
+        """The tick's decisions, in actuation order.  ``obs`` is the
+        controller's windowed delta (see ``Autopilot._observe``); every
+        value is a plain number/dict so replays observe identically."""
+        cfg = self.config
+        out: list = []
+        now = float(obs.get("now", self._clock()))
+        window_s = max(1e-6, float(obs.get("window_s", 1.0)))
+
+        # ---- knob arm -------------------------------------------------
+        knobs: dict = {}
+        served = int(obs.get("served", 0))
+        throttled = int(obs.get("throttled", 0))
+        rpc_rate = served / window_s
+        batch = int(obs.get("batch")
+                    or self._s["batch_hint"] or cfg.min_batch)
+        if served and rpc_rate > cfg.target_rpc_per_s \
+                and batch < cfg.max_batch:
+            knobs["batch_hint"] = min(cfg.max_batch, batch * 2)
+        elif served and rpc_rate < cfg.target_rpc_per_s / 4 \
+                and batch > cfg.min_batch:
+            knobs["batch_hint"] = max(cfg.min_batch, batch // 2)
+        inflight = int(obs.get("max_inflight")
+                       or self._s["max_inflight"] or cfg.min_inflight)
+        if throttled > 0:
+            self._s["calm_ticks"] = 0
+            if inflight < cfg.max_inflight:
+                knobs["max_inflight"] = min(cfg.max_inflight, inflight * 2)
+        else:
+            self._s["calm_ticks"] = int(self._s["calm_ticks"]) + 1
+            if self._s["calm_ticks"] >= cfg.calm_ticks_to_narrow \
+                    and inflight > cfg.min_inflight \
+                    and self._s["max_inflight"] is not None:
+                knobs["max_inflight"] = max(cfg.min_inflight, inflight // 2)
+                self._s["calm_ticks"] = 0
+        if knobs:
+            self._s["batch_hint"] = knobs.get(
+                "batch_hint", self._s["batch_hint"])
+            self._s["max_inflight"] = knobs.get(
+                "max_inflight", self._s["max_inflight"])
+            out.append(self._emit(
+                "tune", args=knobs,
+                reason=f"rpc_rate={rpc_rate:.1f}/s "
+                       f"throttled={throttled}/window"))
+
+        # ---- shed arm -------------------------------------------------
+        scale = float(self._s["scale"])
+        if throttled >= cfg.shed_threshold:
+            new_scale = min(cfg.max_shed_scale, scale * 2.0)
+        elif throttled == 0 and scale > 1.0:
+            new_scale = max(1.0, scale / 2.0)
+        else:
+            new_scale = scale
+        if new_scale != scale:
+            self._s["scale"] = new_scale
+            out.append(self._emit(
+                "shed", args={"scale": new_scale},
+                reason=f"throttled={throttled} (threshold "
+                       f"{cfg.shed_threshold}); retry_ms x{new_scale:g}"))
+
+        # ---- backend arm ----------------------------------------------
+        cand = obs.get("backend_candidate")
+        cur = self._s["backend"] or obs.get("backend_current")
+        if cfg.backend_pick and cand is not None and cand != cur:
+            self._s["backend"] = str(cand)
+            out.append(self._emit(
+                "pick_backend", args={"backend": str(cand)},
+                reason=f"regen cost model prefers {cand} over {cur}"))
+
+        # ---- shard-map arm --------------------------------------------
+        structural = False
+        shards = obs.get("shards") or {}
+        live = {int(s): d for s, d in shards.items()
+                if int(d.get("ranks", 0)) > 0}
+        last_t = self._s["last_struct_t"]
+        cooled = last_t is None or now - float(last_t) \
+            >= cfg.struct_cooldown_s
+        if len(live) >= 2 and cooled:
+            mean = sum(d.get("served", 0) for d in live.values()) \
+                / len(live)
+            if mean > 0:
+                d = self._struct_decision(live, mean, cfg)
+                if d is not None:
+                    structural = True
+                    self._s["last_struct_t"] = now
+                    out.append(d)
+
+        # ---- drill arm ------------------------------------------------
+        if cfg.drill_interval_s is not None and not structural:
+            lag = obs.get("repl_lag_p95_ms")
+            last = self._s["last_drill_t"]
+            due = last is None or now - float(last) >= cfg.drill_interval_s
+            if due and lag is not None and lag <= cfg.drill_max_lag_ms:
+                self._s["last_drill_t"] = now
+                out.append(self._emit(
+                    "drill",
+                    reason=f"repl_lag p95 {lag:.1f}ms <= "
+                           f"{cfg.drill_max_lag_ms:g}ms; promoting "
+                           "standby to measure failover"))
+        return out
+
+    # ------------------------------------------------------------ helpers
+    def _emit(self, kind: str, *, target=None, args=None,
+              reason: str = "") -> Decision:
+        self._s["seq"] = int(self._s["seq"]) + 1
+        return Decision(seq=int(self._s["seq"]), kind=kind,
+                        target=target, args=dict(args or {}),
+                        reason=reason)
+
+    def _struct_decision(self, live: dict, mean: float,
+                         cfg: PolicyConfig) -> Optional[Decision]:
+        """One structural move, by fixed priority: split the hottest
+        qualifying shard, else merge the coldest rank-adjacent pair,
+        else migrate across the steepest adjacent hot/cold boundary.
+        Ties break on the lowest shard id — determinism, not fairness."""
+        order = sorted(live)  # by shard id: deterministic tie-break
+        hot = [s for s in order
+               if live[s].get("served", 0) > cfg.hot_factor * mean
+               and live[s].get("ranks", 0) >= 2 * cfg.min_shard_ranks
+               and float(live[s].get("p99_ms", 0.0)) >= cfg.split_p99_ms]
+        if hot:
+            sid = max(hot, key=lambda s: (live[s]["served"], -s))
+            return self._emit(
+                "split", target=int(sid),
+                reason=f"shard {sid} served {live[sid]['served']} "
+                       f"(> {cfg.hot_factor:g}x mean {mean:.0f}) with "
+                       f"p99 {live[sid].get('p99_ms', 0.0):.1f}ms")
+        cold = {s for s in order
+                if live[s].get("served", 0) < cfg.cold_factor * mean}
+        for a, b in self._adjacent_pairs(live, order):
+            if a in cold and b in cold:
+                # fold the higher slice into the lower: one survivor
+                into, frm = (a, b) if live[a]["lo"] < live[b]["lo"] \
+                    else (b, a)
+                return self._emit(
+                    "merge", args={"into": int(into), "frm": int(frm)},
+                    reason=f"shards {a} and {b} both under "
+                           f"{cfg.cold_factor:g}x mean {mean:.0f}")
+        for a, b in self._adjacent_pairs(live, order):
+            sa, sb = live[a].get("served", 0), live[b].get("served", 0)
+            hi_s, lo_s = (a, b) if sa >= sb else (b, a)
+            if live[hi_s].get("served", 0) > cfg.hot_factor * mean \
+                    and live[lo_s].get("served", 0) < mean \
+                    and live[hi_s].get("ranks", 0) \
+                    > 2 * cfg.min_shard_ranks:
+                count = max(1, int(live[hi_s]["ranks"]) // 4)
+                return self._emit(
+                    "migrate",
+                    args={"frm": int(hi_s), "to": int(lo_s),
+                          "count": count},
+                    reason=f"shard {hi_s} at {live[hi_s]['served']} vs "
+                           f"{lo_s} at {live[lo_s]['served']}; moving "
+                           f"{count} boundary rank(s)")
+        return None
+
+    @staticmethod
+    def _adjacent_pairs(live: dict, order) -> list:
+        """Rank-adjacent (lo-sorted) shard id pairs, deterministic."""
+        by_lo = sorted(order, key=lambda s: int(live[s].get("lo", 0)))
+        return [(by_lo[i], by_lo[i + 1]) for i in range(len(by_lo) - 1)
+                if int(live[by_lo[i]].get("hi", -1))
+                == int(live[by_lo[i + 1]].get("lo", -2))]

@@ -42,6 +42,9 @@ SITES = frozenset({
     "capability.verify",     # a client verifying a received capability
     "stream.append",         # a feeder APPEND extending the index space
     "stream.advance",        # the ack-gated horizon-advance barrier
+    "autopilot.decide",      # the controller evaluating one policy tick
+    "shard.split",           # the plane starting a split-off shard
+    "shard.migrate",         # the two-phase cross-shard rank handoff
 })
 
 #: what a firing rule does (interpreted by runtime.perform / the sites)

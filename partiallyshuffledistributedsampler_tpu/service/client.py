@@ -236,6 +236,7 @@ class ServiceIndexClient:
         capability_heartbeat_s: float = 1.0,
         clock=None,
         attach: bool = False,
+        auto_batch: bool = False,
     ) -> None:
         self.address = _parse_address(address)
         self.rank = None if rank is None else int(rank)
@@ -261,6 +262,11 @@ class ServiceIndexClient:
         self.lookahead = int(lookahead)
         if self.lookahead < 1:
             raise ValueError(f"lookahead must be >= 1, got {lookahead}")
+        #: opt in to the server's autopilot batch suggestion: a WELCOME
+        #: ``batch_hint`` (or a heartbeat ``knobs`` field) is adopted at
+        #: the next epoch boundary (docs/AUTOPILOT.md)
+        self.auto_batch = bool(auto_batch)
+        self._batch_hint: Optional[int] = None
         #: per-deployment HMAC key for verifying signed epoch
         #: capabilities (docs/CAPABILITY.md); None disables the
         #: capability-mode stream entirely
@@ -509,6 +515,12 @@ class ServiceIndexClient:
         mi = header.get("max_inflight")
         if mi is not None:
             self._server_max_inflight = max(1, int(mi))
+        bh = header.get("batch_hint")
+        if bh is not None:
+            # autopilot-tuned batch suggestion (docs/AUTOPILOT.md);
+            # adopted at the next clean epoch boundary, never mid-epoch
+            # — the seq unit IS the batch size
+            self._batch_hint = max(1, int(bh))
         self._adopt_membership(header)
         self._sock = sock
         self._promote_on_connect = False
@@ -821,10 +833,16 @@ class ServiceIndexClient:
     def _failover_peer(self, tried) -> Optional[tuple]:
         """The peer this operation has not yet spent a budget on (the
         standby learned at WELCOME), or None when every peer is spent —
-        the caller's signal that both peers are down."""
+        the caller's signal that both peers are down.  On a sharded
+        deployment the router is the peer of last resort: a merged-out
+        shard's address dies for good, but the router's fresh map
+        re-points us at whichever shard owns our rank now."""
         sb = self.standby_address
         if sb is not None and sb not in tried:
             return sb
+        ra = self._router_address
+        if ra is not None and ra not in tried and ra != self.address:
+            return ra
         return None
 
     def _begin_failover(self, peer: tuple, tried: set):
@@ -1069,6 +1087,15 @@ class ServiceIndexClient:
         (terminal drain eof) or the shrunken world has no free slot left
         (``membership_lost`` in the metrics)."""
         epoch, seq = int(epoch), int(start_seq)
+        if (self.auto_batch and seq == 0 and self._batch_hint is not None
+                and int(self._batch_hint) != self.batch):
+            # clean boundary: nothing is delivered at this batch
+            # geometry yet.  The lease's batch is bound at HELLO, so
+            # adopt by re-dialing — the next request re-HELLOs with the
+            # new size, and the queued previous-epoch ``hb`` ack still
+            # rides that first request (docs/AUTOPILOT.md)
+            self.close()
+            self.batch = int(self._batch_hint)
         self._cursor = {"epoch": epoch, "seq": seq}
         if self._samples_epoch != epoch:
             # new epoch: the trail describes the previous epoch's
@@ -1239,7 +1266,24 @@ class ServiceIndexClient:
         # reply names this rank's drain watermark (additive field;
         # served-batch clients never see it)
         self._cap_drain = rheader.get("cap_drain")
+        kn = rheader.get("knobs")
+        if kn:
+            self._adopt_knobs(kn)
         return int(rheader.get("generation", self.generation))
+
+    def _adopt_knobs(self, kn: dict) -> None:
+        """Adopt autopilot-tuned knobs riding a heartbeat reply
+        (docs/AUTOPILOT.md).  ``max_inflight`` applies live — the
+        pipelined top-up re-reads it on every send — while a batch
+        hint waits for the next epoch boundary, because mid-epoch the
+        seq unit is the batch and re-slicing delivered spans would
+        break exactly-once."""
+        mi = kn.get("max_inflight")
+        if mi is not None:
+            self._server_max_inflight = max(1, int(mi))
+        bh = kn.get("batch_hint")
+        if bh is not None:
+            self._batch_hint = max(1, int(bh))
 
     def _queue_trail_ack(self, epoch: int) -> None:
         """Queue the pre-barrier ack watermark (the trail's last recorded

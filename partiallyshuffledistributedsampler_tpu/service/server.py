@@ -114,6 +114,7 @@ from ..utils.checkpoint import (
     tenant_snapshot_path,
 )
 from . import protocol as P
+from .backpressure import BackpressurePolicy
 from .dispatch import DispatchListener
 from .metrics import ServiceMetrics
 from .replication import ReplicationLog, ReplicationShipper, TenantTaggedLog
@@ -196,6 +197,7 @@ class IndexServer(DispatchListener):
         wal_dir: Optional[str] = None,
         fsync: str = "group_commit",
         capability_secret=None,
+        backpressure: Optional[BackpressurePolicy] = None,
     ) -> None:
         if max_inflight < 1:
             raise ValueError(f"max_inflight must be >= 1, got {max_inflight}")
@@ -205,6 +207,23 @@ class IndexServer(DispatchListener):
         self.spec = spec
         self.host, self.port = host, int(port)
         self.max_inflight = int(max_inflight)
+        #: every typed retry_ms hint comes from this table
+        #: (service/backpressure.py) — tests pin sites, the autopilot's
+        #: shed arm scales the whole table with observed queue depth
+        self.backpressure = (backpressure if backpressure is not None
+                             else BackpressurePolicy())
+        # ---- autopilot knobs (docs/AUTOPILOT.md) ----
+        #: transport-batch size recommended to clients; None until a
+        #: controller tunes it (zero WELCOME/heartbeat bytes until then)
+        self._batch_hint: Optional[int] = None
+        #: True once a controller touched a knob: heartbeat replies then
+        #: carry the additive ``knobs`` field so already-connected
+        #: clients adopt re-sized windows without a re-HELLO
+        self._advertise_knobs = False
+        #: newest replicated controller policy state (an ``autopilot``
+        #: WAL record) — a promoted standby's controller resumes the
+        #: closed loop from here  # guarded by: self._lock
+        self._autopilot_state: Optional[dict] = None
         self.heartbeat_timeout = float(heartbeat_timeout)
         self.membership_timeout = (
             None if membership_timeout is None else float(membership_timeout)
@@ -525,6 +544,9 @@ class IndexServer(DispatchListener):
             role=self.role,
             regen_scheduler=self._regen_sched,
             capability_secret=self.capability_secret,
+            # shared object, not a copy: an autopilot shed-scale on the
+            # front paces every tenant's refusals too
+            backpressure=self.backpressure,
         )
         eng.quota = q
         eng._parent = self
@@ -992,7 +1014,9 @@ class IndexServer(DispatchListener):
         with self._lock:
             pa = self._primary_addr
             return {
-                "code": "standby", "retry_ms": 100, "term": int(self.term),
+                "code": "standby",
+                "retry_ms": self.backpressure.retry_ms("standby"),
+                "term": int(self.term),
                 "primary": (list(pa) if pa is not None else None),
                 "detail": "this server is a hot standby; data ops are "
                           "refused until a promotion",
@@ -1177,6 +1201,23 @@ class IndexServer(DispatchListener):
                         {int(ep): tuple(int(x) for x in w)})
                 self.epoch = max(self.epoch, int(ep))
                 self._stream_pending = None
+        elif op == "autopilot":
+            # a controller decision (autopilot/controller.py): keep the
+            # NEWEST policy state only — a promoted standby seeds its
+            # own controller from it, so the decision stream continues
+            # instead of restarting cold (docs/AUTOPILOT.md).  Knob
+            # values ride the record too: the mirror advertises the
+            # same tuned batch/inflight its primary did.
+            st = rec.get("pstate")
+            if st is not None:
+                self._autopilot_state = dict(st)
+            kn = rec.get("knobs") or {}
+            if kn.get("max_inflight") is not None:
+                self.max_inflight = max(1, int(kn["max_inflight"]))
+            if kn.get("batch_hint") is not None:
+                self._batch_hint = max(1, int(kn["batch_hint"]))
+            if kn:
+                self._advertise_knobs = True
         # unknown ops fall through: the record vocabulary is additive
 
     def _on_repl_sync(self, sock, header) -> None:
@@ -1467,7 +1508,7 @@ class IndexServer(DispatchListener):
             P.send_msg(sock, P.MSG_ERROR, {
                 "code": "draining",
                 "detail": "server is stopping; reconnect shortly",
-                "retry_ms": 200,
+                "retry_ms": self.backpressure.retry_ms("draining"),
             })
             return
         if msg == P.MSG_REPL_SYNC:
@@ -1641,6 +1682,13 @@ class IndexServer(DispatchListener):
                         rank, lease, epoch, ack)
             gen = self.generation
             reply = {"generation": gen}
+            kn = self._knob_fields()
+            if kn:
+                # additive: autopilot-tuned knobs ride the keepalive so
+                # live clients adopt them without reconnecting; absent
+                # until a controller first touches one, so a disabled
+                # autopilot costs zero protocol bytes (docs/AUTOPILOT.md)
+                reply["knobs"] = kn
             rs = self._reshard
             rec = (self._cap_records.get(int(rank))
                    if rank is not None else None)
@@ -1724,7 +1772,8 @@ class IndexServer(DispatchListener):
             self.metrics.inc("capability_rejects", rank)
             _annotate(error_code="capability_issue")
             P.send_msg(sock, P.MSG_ERROR, {
-                "code": "capability_issue", "retry_ms": 50,
+                "code": "capability_issue",
+                "retry_ms": self.backpressure.retry_ms("capability_issue"),
                 "detail": f"capability issuance refused ({exc!r}); retry",
             })
             return
@@ -1754,7 +1803,9 @@ class IndexServer(DispatchListener):
                 # snapshot the freeze took; refuse like GET_BATCH does
                 _annotate(error_code="reshard")
                 P.send_msg(sock, P.MSG_ERROR, {
-                    "code": "reshard", "retry_ms": 20,
+                    "code": "reshard",
+                    "retry_ms":
+                        self.backpressure.retry_ms("reshard_freeze"),
                     "detail": "reshard barrier is freezing; retry shortly",
                 })
                 return
@@ -1774,7 +1825,9 @@ class IndexServer(DispatchListener):
                 # retry is issued against the fresh membership
                 _annotate(error_code="reshard")
                 P.send_msg(sock, P.MSG_ERROR, {
-                    "code": "reshard", "retry_ms": 20,
+                    "code": "reshard",
+                    "retry_ms":
+                        self.backpressure.retry_ms("reshard_freeze"),
                     "detail": "reshard committed mid-issuance; retry",
                 })
                 return
@@ -1822,7 +1875,8 @@ class IndexServer(DispatchListener):
             self.metrics.inc("capability_stale", rank)
             _annotate(error_code="capability_stale")
             P.send_msg(sock, P.MSG_ERROR, {
-                "code": "capability_stale", "retry_ms": 20,
+                "code": "capability_stale",
+                "retry_ms": self.backpressure.retry_ms("capability_stale"),
                 "detail": f"generation {header.get('gen')} was revoked "
                           f"(now at {cur_gen}); adopt the attached "
                           "membership and capability",
@@ -2284,7 +2338,9 @@ class IndexServer(DispatchListener):
             if rs is not None:
                 if rs.get("phase") != "drain":
                     P.send_msg(sock, P.MSG_ERROR, {
-                        "code": "reshard", "retry_ms": 20,
+                        "code": "reshard",
+                        "retry_ms":
+                            self.backpressure.retry_ms("reshard_freeze"),
                         "detail": "a reshard barrier is freezing; retry",
                     })
                     return
@@ -2310,7 +2366,8 @@ class IndexServer(DispatchListener):
             # lost a race with a concurrent trigger; the client's retry
             # joins that barrier through the branch above
             P.send_msg(sock, P.MSG_ERROR, {
-                "code": "reshard", "retry_ms": 20,
+                "code": "reshard",
+                "retry_ms": self.backpressure.retry_ms("reshard_freeze"),
                 "detail": "another reshard started concurrently; retry",
             })
             return
@@ -2341,7 +2398,8 @@ class IndexServer(DispatchListener):
             return
         if not self._trigger_reshard(new_world):
             P.send_msg(sock, P.MSG_ERROR, {
-                "code": "reshard", "retry_ms": 50,
+                "code": "reshard",
+                "retry_ms": self.backpressure.retry_ms("reshard_conflict"),
                 "detail": "a reshard is already draining; retry",
             })
             return
@@ -2436,7 +2494,8 @@ class IndexServer(DispatchListener):
             self.metrics.inc("tenant_admission_rejects")
             _annotate(error_code="tenant_admission")
             P.send_msg(sock, P.MSG_ERROR, {
-                "code": "tenant_admission", "retry_ms": 50,
+                "code": "tenant_admission",
+                "retry_ms": self.backpressure.retry_ms("tenant_admission"),
                 "detail": f"tenant admission refused ({exc!r}); retry",
             })
             return None
@@ -2521,7 +2580,9 @@ class IndexServer(DispatchListener):
                     self.metrics.inc("tenant_admission_rejects")
                     _annotate(error_code="tenant_admission")
                     P.send_msg(sock, P.MSG_ERROR, {
-                        "code": "tenant_admission", "retry_ms": 100,
+                        "code": "tenant_admission",
+                        "retry_ms":
+                            self.backpressure.retry_ms("tenant_ranks"),
                         "tenant": self.tenant_id,
                         "detail": f"tenant {self.tenant_id} holds {live} "
                                   f"live rank leases; quota max_ranks="
@@ -2568,6 +2629,12 @@ class IndexServer(DispatchListener):
                 # additive: shard servers ride their rank→shard map here
                 # (docs/SHARDING.md); empty for a standalone daemon
                 **self._welcome_extra(),
+                # additive: the autopilot's batch-size suggestion; the
+                # field does not exist until a controller has tuned it
+                # (docs/AUTOPILOT.md)
+                **({"batch_hint": int(self._batch_hint)}
+                   if self._advertise_knobs and self._batch_hint is not None
+                   else {}),
             }
         self._write_snapshot()
         P.send_msg(sock, P.MSG_WELCOME, welcome)
@@ -2576,6 +2643,43 @@ class IndexServer(DispatchListener):
         """Extra additive WELCOME fields; ``ShardServer`` overrides to
         attach its ``shard_map`` + ``shard`` id (docs/SHARDING.md)."""
         return {}
+
+    # ------------------------------------------------------------ autopilot
+    def set_autopilot_knobs(self, *, max_inflight=None,
+                            batch_hint=None) -> None:
+        """Adopt controller-tuned serving knobs (autopilot/controller.py).
+
+        The first call flips ``_advertise_knobs``: WELCOME gains the
+        additive ``batch_hint`` field and heartbeat replies gain
+        ``knobs`` — before it, neither exists on the wire, which is the
+        zero-protocol-bytes-while-disabled rail (docs/AUTOPILOT.md).
+        The knob values themselves ride the controller's ``autopilot``
+        WAL record, not this call, so mirrors adopt them there."""
+        with self._lock:
+            if max_inflight is not None:
+                self.max_inflight = max(1, int(max_inflight))
+            if batch_hint is not None:
+                self._batch_hint = max(1, int(batch_hint))
+            self._advertise_knobs = True
+
+    def autopilot_state(self) -> Optional[dict]:
+        """The newest controller policy state replicated to this server
+        (the ``autopilot`` WAL record's ``pstate``).  A promoted standby
+        hands it to its own controller so decisions RESUME from the old
+        primary's trajectory instead of restarting cold."""
+        with self._lock:
+            st = self._autopilot_state
+            return dict(st) if st is not None else None
+
+    def _knob_fields(self) -> dict:
+        """Additive knob advertisement for heartbeat replies; empty
+        until ``set_autopilot_knobs`` has ever run."""
+        if not self._advertise_knobs:
+            return {}
+        kn = {"max_inflight": int(self.max_inflight)}
+        if self._batch_hint is not None:
+            kn["batch_hint"] = int(self._batch_hint)
+        return kn
 
     def _claim_rank_locked(self, want: int, conn_id: int, now: float):
         """Grant ``want`` (or the lowest free rank for -1).  Called under
@@ -2645,7 +2749,9 @@ class IndexServer(DispatchListener):
             if rs is not None and rs.get("phase") == "freeze":
                 _annotate(error_code="reshard")
                 P.send_msg(sock, P.MSG_ERROR, {
-                    "code": "reshard", "retry_ms": 20,
+                    "code": "reshard",
+                    "retry_ms":
+                        self.backpressure.retry_ms("reshard_freeze"),
                     "detail": "reshard barrier is freezing; retry shortly",
                 })
                 return
@@ -2693,7 +2799,7 @@ class IndexServer(DispatchListener):
                     "detail": f"seq {seq} is {seq - cur['acked']} past the "
                               f"acked cursor; max_inflight="
                               f"{self.max_inflight}",
-                    "retry_ms": 20,
+                    "retry_ms": self.backpressure.retry_ms("throttle"),
                 })
                 return
             clamp = None
@@ -2709,7 +2815,9 @@ class IndexServer(DispatchListener):
                         # reply must stay resendable, so the drain
                         # completes only on the client's ack
                         reply = (P.MSG_ERROR, {
-                            "code": "reshard", "retry_ms": 20,
+                            "code": "reshard",
+                            "retry_ms":
+                                self.backpressure.retry_ms("reshard_freeze"),
                             "detail": f"rank {rank} reached its barrier "
                                       "target without acking the full "
                                       "pre-barrier span; retry",
@@ -2740,7 +2848,9 @@ class IndexServer(DispatchListener):
                                          "new membership"), b"")
                         else:
                             reply = (P.MSG_ERROR, {
-                                "code": "reshard", "retry_ms": 20,
+                                "code": "reshard",
+                                "retry_ms": self.backpressure.retry_ms(
+                                    "reshard_freeze"),
                                 "detail": f"rank {rank} drained to its "
                                           "barrier target; waiting for "
                                           "the commit",
@@ -2804,7 +2914,9 @@ class IndexServer(DispatchListener):
                 # freeze took (the span would also ride the repartitioned
                 # remainder, i.e. be served twice) — refuse; the retry is
                 # served clamped once the drain opens
-                stale = {"code": "reshard", "retry_ms": 20,
+                stale = {"code": "reshard",
+                         "retry_ms":
+                             self.backpressure.retry_ms("reshard_freeze"),
                          "detail": "reshard barrier froze mid-request; "
                                    "retry shortly"}
             elif (rs is not None and rs.get("phase") == "drain"
@@ -2813,7 +2925,9 @@ class IndexServer(DispatchListener):
                 # same race, one tick later: the barrier froze AND opened
                 # its drain mid-request, and this unclamped slice overruns
                 # the rank's drain target — refuse rather than duplicate
-                stale = {"code": "reshard", "retry_ms": 20,
+                stale = {"code": "reshard",
+                         "retry_ms":
+                             self.backpressure.retry_ms("reshard_freeze"),
                          "detail": "reshard barrier cut below this batch "
                                    "mid-request; retry shortly"}
             else:
@@ -2881,7 +2995,8 @@ class IndexServer(DispatchListener):
             # stream_seq makes the retry exactly-once
             _annotate(error_code="stream_append")
             P.send_msg(sock, P.MSG_ERROR, {
-                "code": "stream_append", "retry_ms": 25,
+                "code": "stream_append",
+                "retry_ms": self.backpressure.retry_ms("stream_append"),
                 "detail": f"append refused ({exc!r}); retry",
             })
             return
@@ -2977,7 +3092,8 @@ class IndexServer(DispatchListener):
             # the full block and the stream stays pure
             _annotate(error_code="horizon_pending")
             return ({
-                "code": "horizon_pending", "retry_ms": 25,
+                "code": "horizon_pending",
+                "retry_ms": self.backpressure.retry_ms("horizon_gate"),
                 "appended": int(self._stream_appended),
                 "eligible": int(eligible),
                 "detail": f"horizon {epoch} is not fully appended "
@@ -2991,7 +3107,8 @@ class IndexServer(DispatchListener):
         if epoch > self.epoch + 1:
             _annotate(error_code="horizon_advance")
             return ({
-                "code": "horizon_advance", "retry_ms": 25,
+                "code": "horizon_advance",
+                "retry_ms": self.backpressure.retry_ms("horizon_gate"),
                 "epoch": int(self.epoch),
                 "detail": f"horizon {epoch} is {epoch - self.epoch} "
                           f"ahead of the stream (at {self.epoch}); "
@@ -3001,7 +3118,8 @@ class IndexServer(DispatchListener):
         if stragglers:
             _annotate(error_code="horizon_advance")
             return ({
-                "code": "horizon_advance", "retry_ms": 25,
+                "code": "horizon_advance",
+                "retry_ms": self.backpressure.retry_ms("horizon_gate"),
                 "epoch": int(self.epoch),
                 "detail": f"ranks {stragglers} have not acked their "
                           f"full horizon-{self.epoch} allocation",
@@ -3015,7 +3133,8 @@ class IndexServer(DispatchListener):
             # rolls back to exactly the pre-advance state
             _annotate(error_code="horizon_advance")
             return ({
-                "code": "horizon_advance", "retry_ms": 25,
+                "code": "horizon_advance",
+                "retry_ms": self.backpressure.retry_ms("horizon_gate"),
                 "epoch": int(self.epoch),
                 "detail": f"advance aborted ({exc!r}); retry",
             }, False)

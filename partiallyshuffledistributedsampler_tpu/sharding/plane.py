@@ -16,6 +16,7 @@ from __future__ import annotations
 import os
 from typing import Optional
 
+from .. import faults as F
 from .router import ShardRouter
 from .shardmap import ShardMap
 from .shards import ShardServer
@@ -98,3 +99,85 @@ class ShardPlane:
 
     def __exit__(self, *exc) -> None:
         self.stop()
+
+    # --------------------------------------------------- elastic topology
+    # The autopilot's shard-map arm (docs/AUTOPILOT.md) drives these;
+    # each composes a ShardMap transform with the router's two-phase
+    # remap, so clients ride a ``wrong_shard`` redirect — never a
+    # generation bump — and folded streams stay bit-identical.
+    def _server(self, shard_id: int) -> ShardServer:
+        for srv in self.shards:
+            if srv.shard_id == int(shard_id):
+                return srv
+        raise KeyError(f"no live shard {shard_id}")
+
+    def _adopt_standby_maps(self, new_map: ShardMap) -> None:
+        for sb in self.standbys:
+            sb.adopt_map(new_map)
+
+    def split_shard(self, shard_id: int, at: Optional[int] = None) -> int:
+        """Split a hot shard: start a NEW server over the upper half of
+        its slice, then hand those ranks over via the router's
+        two-phase remap.  Returns the new shard's id."""
+        F.fire("shard.split")
+        new_map = self.map.split(shard_id, at)
+        new_sid = new_map.n_shards - 1
+        kw = dict(self.server_kwargs)
+        kw.setdefault("multi_tenant", self.multi_tenant)
+        standby_addr = None
+        sb = None
+        if self.with_standby:
+            sb = ShardServer(self.spec, new_sid, new_map, self.host, 0,
+                             role="standby",
+                             snapshot_path=self._snap(
+                                 f"shard-{new_sid}-standby.json"),
+                             **kw)
+            sb.start()
+            standby_addr = sb.address
+        srv = ShardServer(self.spec, new_sid, new_map, self.host, 0,
+                          wal_dir=self.wal_dir,
+                          snapshot_path=self._snap(f"shard-{new_sid}.json"),
+                          standby=standby_addr,
+                          **kw)
+        srv.start()
+        new_map.set_addr(new_sid, srv.address)
+        try:
+            self.router.remap(new_map)
+        except Exception:
+            srv.stop()
+            if sb is not None:
+                sb.stop()
+            raise
+        self.shards.append(srv)
+        if sb is not None:
+            self.standbys.append(sb)
+        self.map = new_map
+        self._adopt_standby_maps(new_map)
+        return new_sid
+
+    def merge_shards(self, into_id: int, from_id: int) -> ShardMap:
+        """Fold a cold shard into its rank-adjacent neighbor and stop
+        the emptied server (it redirects its last clients during the
+        remap commit, before it goes away)."""
+        new_map = self.map.merged(into_id, from_id)
+        self.router.remap(new_map)
+        self.map = new_map
+        self._adopt_standby_maps(new_map)
+        victim = self._server(from_id)
+        self.shards.remove(victim)
+        victim.stop()
+        for sb in list(self.standbys):
+            if sb.shard_id == int(from_id):
+                self.standbys.remove(sb)
+                sb.stop()
+        return new_map
+
+    def migrate_ranks(self, from_id: int, to_id: int,
+                      count: int) -> ShardMap:
+        """Shift ``count`` boundary ranks from one shard to its
+        rank-adjacent neighbor (both stay live)."""
+        new_map = self.map.migrated(from_id, to_id, count)
+        self.router.remap(new_map)
+        self.map = new_map
+        self._adopt_standby_maps(new_map)
+        return new_map

@@ -448,6 +448,98 @@ class ShardRouter(DispatchListener):
                         barrier_units=int(barrier))
         return new_map
 
+    def remap(self, new_map: ShardMap) -> ShardMap:
+        """Adopt an elastic map transform (``split``/``merged``/
+        ``migrated`` — the autopilot's shard-map arm): a two-phase
+        cross-shard handoff of exactly the rank spans whose owner
+        changed.  **Prepare** freezes the moving ranks at each source
+        and collects their exported state; **commit** lands each span's
+        records at its new owner FIRST (so the state exists before any
+        client is redirected at it), then flips every shard's map —
+        sources start answering the moved ranks with ``wrong_shard``.
+        No generation bump, no cascade change: the folded streams are
+        bit-identical to the static plane's (docs/AUTOPILOT.md).  Any
+        prepare failure aborts the frozen sources; nothing is bricked."""
+        with self._barrier_lock:
+            F.fire("shard.migrate")
+            self.metrics.inc("shard_migrations")
+            with self._lock:
+                m = self._map
+            if new_map.world != m.world:
+                raise ValueError(
+                    f"remap moves ranks between shards at a fixed world "
+                    f"({m.world}); use reshard() for world changes")
+            if new_map.version <= m.version:
+                raise ValueError(
+                    f"remap needs a newer map (v{new_map.version} <= "
+                    f"v{m.version})")
+            spans = m.moved_spans(new_map)
+            by_src: dict = {}
+            for lo, hi, old_sid, _ in spans:
+                by_src.setdefault(old_sid, []).append([lo, hi])
+            t0 = time.perf_counter()
+            prepared: list = []
+            exports: dict = {}
+            try:
+                for sid in sorted(by_src):
+                    rmsg, rheader = self._shard_rpc(
+                        m.addr(sid), P.MSG_RESHARD,
+                        {"phase": "migrate_prepare",
+                         "spans": by_src[sid]})
+                    if rmsg != P.MSG_OK:
+                        raise RuntimeError(
+                            f"shard {sid} refused migrate_prepare: "
+                            f"{rheader}")
+                    prepared.append(sid)
+                    exports[sid] = rheader.get("records") or []
+            except F.InjectedThreadDeath:
+                raise
+            except Exception:
+                for sid in prepared:
+                    try:
+                        self._shard_rpc(m.addr(sid), P.MSG_RESHARD,
+                                        {"phase": "migrate_abort"})
+                    except (OSError, P.ProtocolError):
+                        pass  # lint: allow-broad-except(best-effort abort; shard sweep self-heals)
+                raise
+            imports: dict = {}
+            for sid in sorted(exports):
+                for rec in exports[sid]:
+                    owner = new_map.owner(int(rec["rank"]))
+                    imports.setdefault(owner, []).append(rec)
+            wire = new_map.to_wire()
+            # every prepared source must commit even when the new map
+            # drops its address (a merge empties it): reach it at its
+            # OLD address so it starts redirecting its moved ranks
+            addr_of: dict = {}
+            for sid in {*prepared, *imports, *self._live_shards(new_map)}:
+                a = (new_map.addr(sid)
+                     if sid < new_map.n_shards else None)
+                if a is None and sid < m.n_shards:
+                    a = m.addr(sid)
+                if a is not None:
+                    addr_of[sid] = a
+            # targets import before sources redirect: a client bounced
+            # at a source must find its cursor already at the new owner
+            order = sorted(imports) + [
+                sid for sid in sorted(addr_of) if sid not in imports]
+            for sid in order:
+                rmsg, rheader = self._shard_rpc(
+                    addr_of[sid], P.MSG_RESHARD,
+                    {"phase": "migrate_commit", "map": wire,
+                     "records": imports.get(sid, [])})
+                if rmsg != P.MSG_OK:
+                    raise RuntimeError(
+                        f"shard {sid} refused migrate_commit: {rheader}")
+            with self._lock:
+                self._map = new_map
+            self.metrics.registry.histogram("shard_migrate_ms").observe(
+                (time.perf_counter() - t0) * 1e3)
+        self._write_snapshot()
+        telemetry.event("router_remap", map_version=new_map.version,
+                        moved=[list(s) for s in spans])
+        return new_map
+
     def _on_reshard(self, sock, header) -> None:
         try:
             new_world = int(header["world"])

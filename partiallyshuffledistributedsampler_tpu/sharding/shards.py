@@ -48,6 +48,16 @@ class ShardServer(IndexServer):
         #: map staged by a phased commit, adopted with the cascade
         #: commit  # guarded by: self._lock
         self._pending_map = None
+        #: ranks frozen mid-migration: the cut is prepared but not yet
+        #: committed, so their GET_BATCHes pause-and-retry rather than
+        #: racing the state handoff  # guarded by: self._lock
+        self._migrating: set = set()
+        #: ranks this shard handed to a sibling at a migrate commit;
+        #: their requests draw ``wrong_shard`` (the same typed redirect
+        #: a misrouted HELLO gets) until the client re-routes — the map
+        #: flip carries NO generation bump, so the stream folds
+        #: bit-identically at the new owner  # guarded by: self._lock
+        self._migrated_out: set = set()
 
     # --------------------------------------------------------- rank gating
     def _owned(self) -> tuple:
@@ -60,7 +70,8 @@ class ShardServer(IndexServer):
         except ValueError:
             owner = None
         return {
-            "code": "wrong_shard", "retry_ms": 25,
+            "code": "wrong_shard",
+            "retry_ms": self.backpressure.retry_ms("wrong_shard"),
             "shard": self.shard_id, "owner": owner,
             "shard_map": m.to_wire(),
             "detail": f"rank {rank} is not owned by shard {self.shard_id} "
@@ -134,7 +145,9 @@ class ShardServer(IndexServer):
             rep = self._reshard_prepare(new_world)
             if rep is None:
                 P.send_msg(sock, P.MSG_ERROR, {
-                    "code": "reshard", "retry_ms": 50,
+                    "code": "reshard",
+                    "retry_ms":
+                        self.backpressure.retry_ms("reshard_conflict"),
                     "detail": "a reshard is already in flight; retry",
                 })
                 return
@@ -164,7 +177,9 @@ class ShardServer(IndexServer):
                 with self._lock:
                     self._pending_map = None
                 P.send_msg(sock, P.MSG_ERROR, {
-                    "code": "reshard", "retry_ms": 50,
+                    "code": "reshard",
+                    "retry_ms":
+                        self.backpressure.retry_ms("reshard_conflict"),
                     "detail": "no prepared barrier to commit",
                 })
                 return
@@ -183,9 +198,138 @@ class ShardServer(IndexServer):
                        {"phase": "abort", "shard": self.shard_id,
                         "aborted": bool(aborted)})
             return
+        if phase == "migrate_prepare":
+            # the CUT: freeze the moving ranks and export their state in
+            # one locked step — after this reply, nothing at this shard
+            # advances them, so the exported records ARE their stream
+            # position (docs/AUTOPILOT.md "Migration")
+            try:
+                spans = [(int(lo), int(hi))
+                         for lo, hi in (header.get("spans") or ())]
+                ranks = sorted({r for lo, hi in spans
+                                for r in range(lo, hi)})
+            except (TypeError, ValueError):
+                P.send_msg(sock, P.MSG_ERROR,
+                           {"code": "bad_request",
+                            "detail": "migrate_prepare needs spans "
+                                      "[[lo, hi), ...]"})
+                return
+            with self._lock:
+                if self._reshard is not None or self._migrating:
+                    P.send_msg(sock, P.MSG_ERROR, {
+                        "code": "reshard",
+                        "retry_ms":
+                            self.backpressure.retry_ms("reshard_conflict"),
+                        "detail": "a barrier or migration is already in "
+                                  "flight; retry",
+                    })
+                    return
+                self._migrating = set(ranks)
+                records = self._export_ranks_locked(ranks)
+            P.send_msg(sock, P.MSG_OK,
+                       {"phase": "migrate_prepare",
+                        "shard": self.shard_id, "records": records})
+            return
+        if phase == "migrate_commit":
+            # both sides of the handoff run this: the TARGET imports the
+            # exported records (re-logged through its own WAL, so the
+            # handoff replays like recovery), everyone adopts the new
+            # map, and the SOURCE starts redirecting the moved ranks
+            map_wire = header.get("map")
+            new_map = (ShardMap.from_wire(map_wire)
+                       if map_wire is not None else None)
+            records = header.get("records") or []
+            with self._lock:
+                for rec in records:
+                    self._import_record_locked(dict(rec))
+                if new_map is not None \
+                        and new_map.version > self.shard_map.version:
+                    self.shard_map = new_map
+                own = self.shard_map.owns
+                self._migrated_out |= {
+                    r for r in self._migrating
+                    if not own(self.shard_id, r)}
+                self._migrated_out = {
+                    r for r in self._migrated_out
+                    if not own(self.shard_id, r)}
+                self._migrating = set()
+                for rank in list(self._leases):
+                    if not own(self.shard_id, rank):
+                        self._leases.pop(rank)
+                        self._vacated.pop(rank, None)
+                version = self.shard_map.version
+            if records:
+                # one durable seed so a crash right after the import
+                # cannot lose the handed-over cursors between WAL seals
+                self._write_snapshot(force=True)
+            telemetry.event("shard_map_adopted", shard=self.shard_id,
+                            version=version)
+            P.send_msg(sock, P.MSG_OK,
+                       {"phase": "migrate_commit",
+                        "shard": self.shard_id, "map_version": version})
+            return
+        if phase == "migrate_abort":
+            with self._lock:
+                self._migrating = set()
+            P.send_msg(sock, P.MSG_OK,
+                       {"phase": "migrate_abort", "shard": self.shard_id})
+            return
         P.send_msg(sock, P.MSG_ERROR,
                    {"code": "bad_request",
                     "detail": f"unknown RESHARD phase {phase!r}"})
+
+    # ------------------------------------------------- rank-state handoff
+    def _export_ranks_locked(self, ranks) -> list:
+        """The moving ranks' state as additive WAL-vocabulary records
+        (cursor / capability / lease), exactly what
+        ``_apply_record_locked`` replays — the migration handoff IS a
+        WAL replay at the new owner.  Under ``self._lock``."""
+        recs = []
+        for rank in ranks:
+            cur = self._cursors.get(rank)
+            if cur is not None:
+                recs.append({"op": "cursor", "rank": int(rank), **cur})
+            cap = self._cap_records.get(rank)
+            if cap is not None:
+                recs.append({"op": "capability", "rank": int(rank), **cap})
+            lease = self._leases.get(rank)
+            if lease is not None and lease.get("batch"):
+                recs.append({"op": "lease", "rank": int(rank),
+                             "batch": int(lease["batch"])})
+        return recs
+
+    def _import_record_locked(self, rec: dict) -> None:
+        """Apply one handed-over record AND re-log it through this
+        shard's own WAL/replication feed, so the import survives a
+        crash and mirrors to this shard's standby."""
+        self._apply_record_locked(dict(rec))
+        op = rec.pop("op")
+        self._repl_append(op, **rec)
+
+    def _on_get_batch(self, sock, conn_id, header) -> None:
+        try:
+            rank = int(header["rank"])
+        except (KeyError, TypeError, ValueError):
+            rank = None
+        if rank is not None:
+            with self._lock:
+                migrating = rank in self._migrating
+                moved = rank in self._migrated_out
+            if migrating:
+                # mid-cut: the rank's exported cursor must not move
+                P.send_msg(sock, P.MSG_ERROR, {
+                    "code": "reshard",
+                    "retry_ms":
+                        self.backpressure.retry_ms("reshard_freeze"),
+                    "detail": f"rank {rank} is frozen mid-migration; "
+                              "retry shortly",
+                })
+                return
+            if moved:
+                self.metrics.inc("migrated_redirects")
+                P.send_msg(sock, P.MSG_ERROR, self._wrong_shard_err(rank))
+                return
+        super()._on_get_batch(sock, conn_id, header)
 
     def _commit_reshard_locked(self) -> bool:
         committed = super()._commit_reshard_locked()

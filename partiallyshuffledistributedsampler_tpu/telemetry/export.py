@@ -57,9 +57,15 @@ def render_prometheus(registry, prefix: str = "psds") -> str:
     ``HostDataLoader.metrics`` both qualify)."""
     reg = getattr(registry, "registry", registry)
     report = reg.report()
+    # counters + histogram buckets come from the same interval-snapshot
+    # primitive the autopilot controller samples (MetricsRegistry
+    # .snapshot(), utils/metrics.py): one capture path, two consumers
+    take = getattr(reg, "snapshot", None)
+    snap = take() if take is not None else {
+        "counters": report.get("counters", {}), "histograms": {}}
     lines: list[str] = []
 
-    for name, value in sorted(report.get("counters", {}).items()):
+    for name, value in sorted(snap.get("counters", {}).items()):
         n = _prom_name(prefix, name)
         lines.append(f"# TYPE {n} counter")
         lines.append(f"{n} {_fmt(value)}")
@@ -71,18 +77,16 @@ def render_prometheus(registry, prefix: str = "psds") -> str:
         lines.append(f"{n}_count {_fmt(count)}")
         lines.append(f"{n}_sum {_fmt(t.get('mean_ms', 0.0) * count)}")
 
-    states = getattr(reg, "histogram_states", None)
-    if states is not None:
-        for name, st in sorted(states().items()):
-            n = _prom_name(prefix, name)
-            lines.append(f"# TYPE {n} histogram")
-            cum = 0
-            for le, c in zip(st["bounds"], st["counts"]):
-                cum += c
-                lines.append(f'{n}_bucket{{le="{_fmt(le)}"}} {cum}')
-            lines.append(f'{n}_bucket{{le="+Inf"}} {st["count"]}')
-            lines.append(f"{n}_sum {_fmt(st['sum'])}")
-            lines.append(f"{n}_count {st['count']}")
+    for name, st in sorted(snap.get("histograms", {}).items()):
+        n = _prom_name(prefix, name)
+        lines.append(f"# TYPE {n} histogram")
+        cum = 0
+        for le, c in zip(st["bounds"], st["counts"][:-1]):
+            cum += c
+            lines.append(f'{n}_bucket{{le="{_fmt(le)}"}} {cum}')
+        lines.append(f'{n}_bucket{{le="+Inf"}} {st["count"]}')
+        lines.append(f"{n}_sum {_fmt(st['sum'])}")
+        lines.append(f"{n}_count {st['count']}")
 
     return "\n".join(lines) + ("\n" if lines else "")
 

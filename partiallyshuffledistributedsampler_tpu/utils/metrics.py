@@ -114,6 +114,78 @@ class RegenTimer:
 _DEFAULT_BOUNDS = tuple(0.001 * 2 ** k for k in range(32))
 
 
+def _percentile_from(bounds, counts, count, vmin, vmax, q: float) -> float:
+    """Interpolated q-quantile over raw bucket ``counts`` (overflow bucket
+    last).  Pure — :class:`Histogram` delegates here for its lifetime
+    percentiles and :func:`histogram_delta` reuses it on interval-delta
+    counts, so windowed and cumulative views cannot drift apart."""
+    if count <= 0:
+        return 0.0
+    target = q * count
+    cum = 0
+    for i, c in enumerate(counts):
+        if c == 0:
+            continue
+        if cum + c >= target:
+            lo = bounds[i - 1] if i > 0 else 0.0
+            hi = bounds[i] if i < len(bounds) else vmax
+            frac = (target - cum) / c
+            est = lo + (hi - lo) * max(0.0, min(1.0, frac))
+            return max(vmin, min(vmax, est))
+        cum += c
+    return vmax
+
+
+def histogram_delta(cur: dict, prev=None) -> dict:
+    """Windowed report between two :meth:`Histogram.snapshot` values.
+
+    ``prev=None`` means "since the start" (an all-zero baseline), so the
+    delta of a first interval equals the lifetime report.  Interval
+    percentiles interpolate the *differenced* bucket counts; min/max are
+    not tracked per interval, so the estimate clamps to the lifetime
+    envelope — good enough for a controller comparing against
+    thresholds, and exact whenever an interval spans the whole life."""
+    bounds = cur["bounds"]
+    if prev is None:
+        dcounts = list(cur["counts"])
+        dsum = float(cur["sum"])
+        dcount = int(cur["count"])
+    else:
+        dcounts = [int(c) - int(p)
+                   for c, p in zip(cur["counts"], prev["counts"])]
+        dsum = float(cur["sum"]) - float(prev["sum"])
+        dcount = int(cur["count"]) - int(prev["count"])
+    if dcount <= 0:
+        return {"count": 0, "mean_ms": 0.0, "p50_ms": 0.0,
+                "p95_ms": 0.0, "p99_ms": 0.0, "max_ms": 0.0}
+    vmin, vmax = float(cur["min"]), float(cur["max"])
+    return {
+        "count": dcount,
+        "mean_ms": round(dsum / dcount, 3),
+        "p50_ms": round(_percentile_from(bounds, dcounts, dcount,
+                                         vmin, vmax, 0.50), 3),
+        "p95_ms": round(_percentile_from(bounds, dcounts, dcount,
+                                         vmin, vmax, 0.95), 3),
+        "p99_ms": round(_percentile_from(bounds, dcounts, dcount,
+                                         vmin, vmax, 0.99), 3),
+        "max_ms": round(vmax, 3),
+    }
+
+
+def registry_delta(cur: dict, prev=None) -> dict:
+    """Windowed view between two :meth:`MetricsRegistry.snapshot` values:
+    counter differences plus :func:`histogram_delta` per histogram.
+    Counters absent from ``prev`` delta from zero (created mid-window)."""
+    pc = (prev or {}).get("counters") or {}
+    ph = (prev or {}).get("histograms") or {}
+    return {
+        "counters": {k: int(v) - int(pc.get(k, 0))
+                     for k, v in cur.get("counters", {}).items()},
+        "histograms": {k: histogram_delta(s, ph.get(k))
+                       for k, s in cur.get("histograms", {}).items()},
+    }
+
+
 class Histogram:
     """Fixed log-spaced latency buckets with exact count/sum.
 
@@ -164,32 +236,41 @@ class Histogram:
             return self._percentile_locked(q)
 
     def _percentile_locked(self, q: float) -> float:
-        if self._count == 0:
-            return 0.0
-        target = q * self._count
-        cum = 0
-        for i, c in enumerate(self._counts):
-            if c == 0:
-                continue
-            if cum + c >= target:
-                lo = self.bounds[i - 1] if i > 0 else 0.0
-                hi = self.bounds[i] if i < len(self.bounds) else self._max
-                frac = (target - cum) / c
-                est = lo + (hi - lo) * max(0.0, min(1.0, frac))
-                return max(self._min, min(self._max, est))
-            cum += c
-        return self._max
+        return _percentile_from(self.bounds, self._counts, self._count,
+                                self._min, self._max, q)
+
+    def snapshot(self) -> dict:
+        """Immutable point-in-time capture: every bucket count (overflow
+        last), sum/count, and the observed min/max envelope.  The shared
+        interval primitive — feed two of these to :func:`histogram_delta`
+        (or ``delta(prev)``) for a windowed report; ``state()`` and the
+        Prometheus exporter derive from the same capture."""
+        with self._lock:
+            return {
+                "bounds": self.bounds,
+                "counts": tuple(self._counts),
+                "sum": self._sum,
+                "count": self._count,
+                "min": self._min,
+                "max": self._max,
+            }
+
+    def delta(self, prev=None) -> dict:
+        """Report over the interval since ``prev`` (an earlier
+        ``snapshot()``; ``None`` = since start).  See
+        :func:`histogram_delta`."""
+        return histogram_delta(self.snapshot(), prev)
 
     def state(self) -> dict:
         """Raw bucket state for exporters (per-bucket, not cumulative)."""
-        with self._lock:
-            return {
-                "bounds": list(self.bounds),
-                "counts": list(self._counts[:-1]),
-                "overflow": self._counts[-1],
-                "sum": self._sum,
-                "count": self._count,
-            }
+        s = self.snapshot()
+        return {
+            "bounds": list(s["bounds"]),
+            "counts": list(s["counts"][:-1]),
+            "overflow": s["counts"][-1],
+            "sum": s["sum"],
+            "count": s["count"],
+        }
 
     def report(self) -> dict:
         with self._lock:
@@ -261,6 +342,22 @@ class MetricsRegistry:
         with self._lock:
             hs = dict(self._histograms)
         return {k: h.state() for k, h in hs.items()}
+
+    def snapshot(self) -> dict:
+        """Point-in-time capture of counters + histogram snapshots —
+        the interval baseline the autopilot controller (and anything
+        else computing windowed load) holds between samples.  Timers are
+        excluded: their rings are already windowed by construction."""
+        with self._lock:
+            counters = dict(self._counters)
+            hs = dict(self._histograms)
+        return {"counters": counters,
+                "histograms": {k: h.snapshot() for k, h in hs.items()}}
+
+    def delta(self, prev=None) -> dict:
+        """Windowed view since ``prev`` (an earlier ``snapshot()``;
+        ``None`` = since start).  See :func:`registry_delta`."""
+        return registry_delta(self.snapshot(), prev)
 
     def reset(self) -> None:
         with self._lock:

@@ -38,7 +38,7 @@ from partiallyshuffledistributedsampler_tpu.analysis import lockorder  # noqa: E
 #: leave non-daemon threads behind (docs/ANALYSIS.md "Thread-leak gate")
 _LEAK_CHECKED_MARKS = ("failover", "tenancy", "chaos", "elastic",
                        "telemetry", "durability", "sharding", "capability",
-                       "streaming")
+                       "streaming", "autopilot")
 
 
 @pytest.fixture(autouse=True)

@@ -28,6 +28,7 @@ _NAMESPACES = (
     "partiallyshuffledistributedsampler_tpu.ops.cpu",
     "partiallyshuffledistributedsampler_tpu.service",
     "partiallyshuffledistributedsampler_tpu.sharding",
+    "partiallyshuffledistributedsampler_tpu.autopilot",
     "partiallyshuffledistributedsampler_tpu.capability",
     "partiallyshuffledistributedsampler_tpu.streaming",
     "partiallyshuffledistributedsampler_tpu.telemetry",
@@ -374,4 +375,49 @@ def test_sharding_doc_cross_linked():
 
     res = (DOCS / "RESILIENCE.md").read_text()
     for site in ("router.route", "shard.barrier"):
+        assert site in F.SITES and site in res
+
+
+def test_autopilot_doc_cross_linked():
+    """The autopilot is documented where an operator would look:
+    docs/AUTOPILOT.md owns the loop/arms/migration story (and the make
+    gate), SERVICE.md / SHARDING.md / RESILIENCE.md / OBSERVABILITY.md
+    and README.md link to it, API.md documents the public surface, and
+    every ``autopilot_*`` metric the controller registers is in the
+    OBSERVABILITY.md inventory."""
+    autopilot_md = DOCS / "AUTOPILOT.md"
+    assert autopilot_md.exists()
+    text = autopilot_md.read_text()
+    for token in ("Autopilot", "AutopilotPolicy", "PolicyConfig",
+                  "BackpressurePolicy", "state_dict", "batch_hint",
+                  "max_inflight", "wrong_shard", "prepare", "commit",
+                  "moved_spans", "drill_interval_s", "autopilot-smoke",
+                  "zero protocol bytes"):
+        assert token in text, f"docs/AUTOPILOT.md lost `{token}`"
+    for doc in ("SERVICE.md", "SHARDING.md", "RESILIENCE.md",
+                "OBSERVABILITY.md", "API.md"):
+        assert "AUTOPILOT.md" in (DOCS / doc).read_text(), (
+            f"docs/{doc} lost its cross-link to docs/AUTOPILOT.md")
+    assert "docs/AUTOPILOT.md" in (DOCS.parent / "README.md").read_text()
+    api = API_MD.read_text()
+    for token in ("Autopilot(server=None", "AutopilotPolicy",
+                  "PolicyConfig", "BackpressurePolicy",
+                  "set_autopilot_knobs", "auto_batch=True",
+                  "split_shard", "merge_shards", "migrate_ranks"):
+        assert token in api, f"docs/API.md lost the autopilot surface `{token}`"
+    obs = OBSERVABILITY_MD.read_text()
+    for token in ("autopilot_decisions", "autopilot_tunes",
+                  "autopilot_sheds", "autopilot_splits",
+                  "autopilot_merges", "autopilot_migrations",
+                  "autopilot_drills", "autopilot_backend_picks",
+                  "autopilot_decide_errors", "autopilot_tick_ms",
+                  "autopilot_drill_ms", "shard_migrations",
+                  "shard_migrate_ms", "migrated_redirects"):
+        assert token in obs, (
+            f"docs/OBSERVABILITY.md lost the autopilot metric `{token}`")
+    # the documented fault sites must be the registered ones
+    from partiallyshuffledistributedsampler_tpu import faults as F
+
+    res = (DOCS / "RESILIENCE.md").read_text()
+    for site in ("autopilot.decide", "shard.split", "shard.migrate"):
         assert site in F.SITES and site in res
