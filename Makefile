@@ -76,6 +76,12 @@
 #                          shapes, the controller-driven split drill
 #                          (streams bit-identical), then the calm-
 #                          controller idle-overhead-within-noise bar
+#   * sim smoke            tests/test_fleetsim.py (`-m fleetsim`)
+#                          + benchmarks/sim_smoke.py — deterministic
+#                          fleet simulator: byte-identical decision
+#                          logs, predictive-vs-reactive fixpoint ticks,
+#                          the 5000-rank unattended hotspot drill, and
+#                          the predictive-overhead-within-noise bar
 #   * analyze              project-native static analysis (docs/ANALYSIS.md):
 #                          guarded-by discipline, fault-site/protocol/
 #                          metrics-docs drift, clock discipline, silent-
@@ -90,7 +96,7 @@ PY ?= python
 .PHONY: check test bench native dryrun service-smoke chaos-smoke \
 	elastic-smoke telemetry-smoke failover-smoke tenancy-smoke \
 	durability-smoke fused-smoke sharding-smoke capability-smoke \
-	streaming-smoke autopilot-smoke analyze analysis-smoke
+	streaming-smoke autopilot-smoke sim-smoke analyze analysis-smoke
 
 # the driver parses the LAST line of bench.py's combined output (round 3
 # lost its headline to the details line — BENCH_r03.json "parsed": null),
@@ -203,6 +209,10 @@ streaming-smoke:
 autopilot-smoke:
 	$(PY) -m pytest tests/test_autopilot.py -q -m autopilot -ra
 	$(PY) benchmarks/autopilot_smoke.py
+
+sim-smoke:
+	$(PY) -m pytest tests/test_fleetsim.py -q -m fleetsim -ra
+	$(PY) benchmarks/sim_smoke.py
 
 # static-analysis gate (docs/ANALYSIS.md): every lint pass over the
 # package + docs; any finding is a non-zero exit with file:line output

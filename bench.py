@@ -510,6 +510,22 @@ def main() -> None:
         except Exception as exc:
             details["autopilot_error"] = repr(exc)[:200]
 
+    # detail tier: simulator — fleetsim determinism (byte-identical
+    # decision logs), predictive-vs-reactive ticks-to-fixpoint, the
+    # 5000-rank unattended hotspot drill, warm-restart prior
+    # reproduction, and the predictive per-tick overhead bar
+    # (methodology in benchmarks/sim_smoke.py)
+    if not smoke:
+        try:
+            sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+            from benchmarks.sim_smoke import (
+                summarize as sim_summarize,
+            )
+
+            details["simulator"] = sim_summarize()
+        except Exception as exc:
+            details["simulator_error"] = repr(exc)[:200]
+
     # detail tier: analysis — concurrency-sanitizer overhead: the
     # tracked-lock arm must stay within the raw-lock arm's rep noise
     # and record zero lock-order cycles (methodology in

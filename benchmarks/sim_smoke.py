@@ -1,0 +1,213 @@
+"""Fleet-simulator smoke: determinism, predictive gain, hotspot drill.
+
+Two consumers:
+
+* ``make sim-smoke`` / ``python benchmarks/sim_smoke.py`` — the CI
+  gate: (a) the same scenario + seed must serialize to a byte-identical
+  WAL-shaped decision log across two fresh runs (the determinism law);
+  (b) the predictive tune arm must reach the knob fixpoint in strictly
+  fewer ticks than the reactive doubling ladder on the same replayed
+  workload; (c) the 5 000-rank hotspot must resolve through a
+  controller-decided split with no operator action and end unthrottled;
+  (d) a warm-started restart must reproduce the converged knobs in ONE
+  decision; (e) the predictive policy's extra per-tick work (history +
+  slope fits) must disappear into the reactive arm's own rep-to-rep
+  noise.  Exit 0 and one JSON line on success; raises loudly otherwise.
+
+* ``bench.py`` imports :func:`summarize` for ``details["simulator"]``.
+
+Methodology: everything runs on the simulator's virtual clock, so the
+tick counts and decision logs are machine-independent; only the
+predictive-overhead arm measures wall time, and it compares medians of
+interleaved reps against the reactive arm's own min-max spread (the
+``*_within_noise`` convention every bench tier feeds the regression
+tripwire with).  Scenarios and laws: docs/SIMULATOR.md.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+#: interleaved wall-time reps per arm for the overhead measure
+_REPS = 5
+
+
+def _tune_sim(*, predictive: bool, ticks: int = 14):
+    from partiallyshuffledistributedsampler_tpu import fleetsim as fs
+    from partiallyshuffledistributedsampler_tpu.autopilot import PolicyConfig
+
+    sim = fs.FleetSim(
+        world=8, n_shards=2, n=8 << 20,
+        workload=fs.workload.uniform(100_000.0, key="smoke-tune"),
+        seed=3, config=PolicyConfig(predictive=predictive))
+    sim.run(ticks)
+    return sim
+
+
+def _ticks_to_fixpoint(sim) -> int:
+    hist = []
+    for e in sim.trace.entries:
+        b = e["obs"]["batch"]
+        for d in e["decisions"]:
+            if d["kind"] == "tune" and d["args"].get("batch_hint"):
+                b = d["args"]["batch_hint"]
+        hist.append(b)
+    final = hist[-1]
+    return 1 + next(i for i in range(len(hist))
+                    if all(x == final for x in hist[i:]))
+
+
+def _determinism() -> dict:
+    """Two fresh runs, one scenario, one seed: the decision logs must
+    be byte-identical (the law the whole subsystem is named for)."""
+    a, b = _tune_sim(predictive=True), _tune_sim(predictive=True)
+    log = a.trace.decision_log()
+    return {
+        "decision_log_bytes": len(log),
+        "decisions": len(a.trace.decisions()),
+        "byte_identical": bool(log == b.trace.decision_log()
+                               and a.trace.to_jsonl() == b.trace.to_jsonl()),
+    }
+
+
+def _predictive_gain() -> dict:
+    """Ticks-to-fixpoint, reactive vs predictive, same workload; plus
+    the interleaved wall-time comparison feeding the noise tripwire."""
+    reactive = _tune_sim(predictive=False)
+    predictive = _tune_sim(predictive=True)
+    tr, tp = _ticks_to_fixpoint(reactive), _ticks_to_fixpoint(predictive)
+
+    walls = {False: [], True: []}
+    for _ in range(_REPS):
+        for arm in (False, True):       # interleaved: drift hits both
+            t0 = time.perf_counter()
+            _tune_sim(predictive=arm)
+            walls[arm].append((time.perf_counter() - t0) * 1e3)
+    r = sorted(walls[False])
+    p = sorted(walls[True])
+    r_med, p_med = r[len(r) // 2], p[len(p) // 2]
+    noise = max(r) - min(r)
+    return {
+        "reactive_ticks_to_fixpoint": tr,
+        "predictive_ticks_to_fixpoint": tp,
+        "fixpoint_batch": int(predictive.batch),
+        "same_fixpoint": bool(predictive.batch == reactive.batch),
+        "predictive_fewer_ticks": bool(tp < tr),
+        "reactive_wall_ms": round(r_med, 3),
+        "predictive_wall_ms": round(p_med, 3),
+        "reactive_noise_ms": round(noise, 3),
+        "predictive_overhead_within_noise": bool(
+            p_med <= r_med + max(noise, 0.5)),
+    }
+
+
+def _hotspot_drill() -> dict:
+    """The 5 000-rank acceptance scenario: a 10x rank-band hotspot
+    against a tight capacity model must split unattended and end the
+    run unthrottled."""
+    from partiallyshuffledistributedsampler_tpu import fleetsim as fs
+    from partiallyshuffledistributedsampler_tpu.autopilot import PolicyConfig
+
+    cfg = PolicyConfig(min_batch=1024, max_batch=1024, min_inflight=2,
+                       max_inflight=4, hot_factor=2.0, split_p99_ms=5.0,
+                       struct_cooldown_s=3.0, target_rpc_per_s=1e9)
+    t0 = time.perf_counter()
+    sim = fs.FleetSim(
+        world=5000, n_shards=4, n=5000 << 20,
+        workload=fs.workload.hotspot(10.0, hot_lo=0, hot_hi=1250,
+                                     factor=10.0, at_s=5.0, ramp_s=5.0),
+        seed=7, config=cfg,
+        latency=fs.LatencyModel(
+            seed=7, calibration=fs.Calibration(rpc=(40.0, 0.05))))
+    sim.run(40)
+    wall_ms = (time.perf_counter() - t0) * 1e3
+    throttled = [e["obs"]["throttled"] for e in sim.trace.entries]
+    first_hot = next((i + 1 for i, t in enumerate(throttled) if t), None)
+    last_hot = max((i + 1 for i, t in enumerate(throttled) if t),
+                   default=None)
+    return {
+        "world": sim.world,
+        "ticks": sim.ticks,
+        "wall_ms": round(wall_ms, 3),
+        "splits": int(sim.registry.get("sim_splits")),
+        "migrations": int(sim.registry.get("sim_migrations")),
+        "live_shards": len(sim.live_shards()),
+        "first_throttled_tick": first_hot,
+        "resolved_by_tick": last_hot,
+        "end_throttled": int(throttled[-1]),
+        "end_max_util": round(sim.max_util(), 4),
+        "resolved_unattended": bool(
+            sim.registry.get("sim_splits") >= 1 and throttled[-1] == 0
+            and sim.max_util() < 0.9),
+    }
+
+
+def _warm_restart() -> dict:
+    """Learn priors from the first run's WAL-shaped records; the
+    restarted deployment must reproduce the converged knobs in one
+    warm-start tune and then stay knob-quiet."""
+    from partiallyshuffledistributedsampler_tpu import fleetsim as fs
+    from partiallyshuffledistributedsampler_tpu.autopilot import (
+        PolicyConfig,
+        learn_priors,
+        warm_state,
+    )
+
+    first = _tune_sim(predictive=False)
+    priors = learn_priors(first.trace.wal_records())
+    second = fs.FleetSim(
+        world=8, n_shards=2, n=8 << 20,
+        workload=fs.workload.uniform(100_000.0, key="smoke-tune"),
+        seed=3, config=PolicyConfig())
+    second.policy.load_state_dict(warm_state(priors))
+    second.run(10)
+    d0 = second.trace.entries[0]["decisions"]
+    return {
+        "converged_batch": int(first.batch),
+        "warm_batch": int(second.batch),
+        "warm_tunes_total": int(second.registry.get("sim_tunes")),
+        "knobs_reproduced": bool(
+            second.batch == first.batch
+            and second.registry.get("sim_tunes") == 1
+            and d0 and d0[0]["reason"].startswith("warm start from prior")),
+    }
+
+
+def summarize() -> dict:
+    """The ``details["simulator"]`` tier: every law, one dict."""
+    return {
+        "determinism": _determinism(),
+        "predictive": _predictive_gain(),
+        "hotspot": _hotspot_drill(),
+        "warm_restart": _warm_restart(),
+    }
+
+
+def main() -> None:
+    """The `make sim-smoke` gate: hard assertions, one JSON line."""
+    report = summarize()
+    assert report["determinism"]["byte_identical"], (
+        "same scenario + seed produced different bytes: "
+        f"{report['determinism']!r}")
+    p = report["predictive"]
+    assert p["predictive_fewer_ticks"] and p["same_fixpoint"], (
+        f"predictive arm gained nothing: {p!r}")
+    assert report["hotspot"]["resolved_unattended"], (
+        f"hotspot did not resolve unattended: {report['hotspot']!r}")
+    assert report["warm_restart"]["knobs_reproduced"], (
+        f"warm restart failed to reproduce knobs: "
+        f"{report['warm_restart']!r}")
+    assert p["predictive_overhead_within_noise"], (
+        f"predictive per-tick work fell out of the reactive arm's "
+        f"noise: {p['predictive_wall_ms']}ms vs {p['reactive_wall_ms']}ms "
+        f"± {p['reactive_noise_ms']}ms")
+    print(json.dumps({"sim_smoke": "ok", **report}))
+
+
+if __name__ == "__main__":
+    main()

@@ -24,5 +24,7 @@ boolean check per heartbeat.
 
 from .controller import Autopilot
 from .policy import AutopilotPolicy, Decision, PolicyConfig
+from .priors import learn_priors, warm_state, workload_key
 
-__all__ = ["Autopilot", "AutopilotPolicy", "Decision", "PolicyConfig"]
+__all__ = ["Autopilot", "AutopilotPolicy", "Decision", "PolicyConfig",
+           "learn_priors", "warm_state", "workload_key"]

@@ -38,15 +38,22 @@ from .. import faults as F
 from .. import telemetry
 from ..utils.metrics import histogram_delta, registry_delta
 from .policy import AutopilotPolicy, Decision, PolicyConfig
+from .priors import workload_key
 
 
 class Autopilot:
     """Observe → decide → actuate loop for one deployment (module doc)."""
 
+    #: the live autotune probe jit-compiles at two sizes (seconds);
+    #: below this many per-rank samples the pick cannot matter enough
+    #: to amortize it, so the backend arm stays silent
+    BACKEND_PROBE_MIN_SAMPLES = 1 << 16
+
     def __init__(self, server=None, *, plane=None, standby=None,
                  policy: Optional[AutopilotPolicy] = None,
                  config: Optional[PolicyConfig] = None,
-                 interval_s: float = 1.0, clock=None) -> None:
+                 interval_s: float = 1.0, clock=None,
+                 backend_probe=None, observe=None) -> None:
         if (server is None) == (plane is None):
             raise ValueError(
                 "Autopilot drives exactly one deployment: pass server= "
@@ -58,17 +65,38 @@ class Autopilot:
         self._clock = clock if clock is not None else time.monotonic
         self.policy = policy if policy is not None else AutopilotPolicy(
             config, clock=self._clock)
+        #: optional cost-probe override: ``fn(num_samples) ->
+        #: (backend, info)`` in ``utils.autotune.pick_backend``'s shape
+        #: (fleetsim's RegenCostModel.pick adapts directly; tests and
+        #: the sim/real parity suite inject it to skip the jit probe)
+        self._backend_probe = backend_probe
+        #: optional observation override: a callable returning the next
+        #: obs dict, or None when the replayed snapshot stream is
+        #: exhausted (docs/SIMULATOR.md "Replay semantics") — trace
+        #: replays feed a live plane the exact snapshots a simulated
+        #: run observed
+        self._observe_fn = observe
         inherited = self._wal_server().autopilot_state()
         if inherited is not None:
             # a promoted standby hands its mirrored decision state to
             # the new controller: the trajectory RESUMES, not restarts
             self.policy.load_state_dict(inherited)
+            # the mirrored knobs were re-applied by WAL replay, but the
+            # shed scale lives in each server's BackpressurePolicy —
+            # restore it too, or a failover would silently un-shed a
+            # loaded fleet
+            scale = float(self.policy.state_dict().get("scale", 1.0))
+            if scale != 1.0:
+                for srv in self.servers():
+                    srv.backpressure.set_scale(scale)
         #: the registry the autopilot's own metrics ride — the lead
         #: server's, so one METRICS poll shows decisions next to load
         self.registry = self._wal_server().metrics.registry
         self._prev: dict = {}       # per-server snapshot from last tick
         self._prev_t: Optional[float] = None
         self._backend_candidate: Optional[str] = None
+        self._backend_gain: Optional[float] = None
+        self._last_workload: Optional[str] = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -129,7 +157,12 @@ class Autopilot:
             # folds into the next delta, no decision is lost for good
             self.registry.inc("autopilot_decide_errors")
             return []
-        obs = self._observe()
+        obs = self._observe() if self._observe_fn is None \
+            else self._observe_fn()
+        if obs is None:
+            # an injected observation stream (trace replay) ran dry
+            return []
+        self._last_workload = obs.get("workload")
         with telemetry.span("autopilot.tick", served=obs.get("served", 0)):
             decisions = self.policy.decide(obs)
             actuated = []
@@ -183,9 +216,15 @@ class Autopilot:
         lag = self._repl_lag_p95()
         if lag is not None:
             obs["repl_lag_p95_ms"] = lag
+        obs["workload"] = workload_key(lead.spec)
         if self.policy.config.backend_pick:
-            obs["backend_current"] = getattr(lead.spec, "backend", None)
-            obs["backend_candidate"] = self._pick_backend(lead)
+            cand = self._pick_backend(lead)
+            if cand is not None:
+                obs["backend_current"] = getattr(
+                    lead.spec, "backend", None)
+                obs["backend_candidate"] = cand
+                if self._backend_gain is not None:
+                    obs["backend_gain_pct"] = float(self._backend_gain)
         return obs
 
     def _repl_lag_p95(self) -> Optional[float]:
@@ -207,14 +246,32 @@ class Autopilot:
 
     def _pick_backend(self, lead) -> Optional[str]:
         """Resolve the regen backend from the observed cost model (one
-        probe per process, memoized — utils/autotune.py); advisory:
-        the pick is logged + exposed via ``status()``, the training
-        side adopts it at its next spec construction."""
-        if self._backend_candidate is None:
-            from ..utils.autotune import pick_backend
-            per_rank = max(1, int(lead.spec.n or 0)
-                           // max(1, int(lead.spec.world)))
-            self._backend_candidate, _ = pick_backend(per_rank)
+        probe per controller, memoized); advisory: the pick is logged +
+        exposed via ``status()``, the training side adopts it at its
+        next spec construction.  Without an injected ``backend_probe``
+        the live autotune probe (utils/autotune.py) runs — but only for
+        workloads past ``BACKEND_PROBE_MIN_SAMPLES`` per rank, because
+        the probe jit-compiles for seconds and a toy spec can never
+        win enough regen time back."""
+        if self._backend_candidate is not None:
+            return self._backend_candidate
+        per_rank = max(1, int(lead.spec.n or 0)
+                       // max(1, int(lead.spec.world)))
+        probe = self._backend_probe
+        if probe is None:
+            if per_rank < self.BACKEND_PROBE_MIN_SAMPLES:
+                return None
+            from ..utils.autotune import pick_backend as probe
+        cand, info = probe(per_rank)
+        self._backend_candidate = cand
+        if info and info.get("est_host_ms") is not None \
+                and info.get("est_device_ms") is not None:
+            worse = max(float(info["est_host_ms"]),
+                        float(info["est_device_ms"]))
+            best = min(float(info["est_host_ms"]),
+                       float(info["est_device_ms"]))
+            if worse > 0.0:
+                self._backend_gain = 100.0 * (worse - best) / worse
         return self._backend_candidate
 
     # ------------------------------------------------------------ actuate
@@ -281,6 +338,7 @@ class Autopilot:
             "autopilot", seq=int(d.seq), kind=d.kind, target=d.target,
             args=dict(d.args), reason=d.reason,
             knobs=(dict(d.args) if d.kind == "tune" else None),
+            workload=self._last_workload,
             pstate=self.policy.state_dict())
 
     # ------------------------------------------------------------- status

@@ -18,6 +18,7 @@ from .recover import (
     RecoveryError,
     check_invariants,
     last_valid_lsn,
+    read_autopilot_records,
     recover_unstarted,
     replay_wal_tail,
     truncate_wal_copy,
@@ -31,6 +32,7 @@ __all__ = [
     "RecoveryError",
     "check_invariants",
     "last_valid_lsn",
+    "read_autopilot_records",
     "recover_unstarted",
     "replay_wal_tail",
     "truncate_wal_copy",

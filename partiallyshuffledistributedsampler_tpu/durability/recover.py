@@ -208,3 +208,28 @@ def last_valid_lsn(wal_dir: str) -> int:
         if good < len(data):
             break  # torn here: later segments are unreachable
     return last
+
+
+def read_autopilot_records(wal_dir: str) -> list:
+    """Every ``autopilot`` decision record still on disk under
+    ``wal_dir``, in lsn order — the WAL-logged decision history
+    ``autopilot.learn_priors`` rebuilds warm-start priors from, and
+    the live half of the sim/real trace parity comparison
+    (docs/SIMULATOR.md "WAL parity").  A standalone reader: no server,
+    no lock, torn tails simply end the scan the way recovery would."""
+    out = []
+    for name in sorted(os.listdir(wal_dir)):
+        if not _SEG_RE.match(name):
+            continue
+        with open(os.path.join(wal_dir, name), "rb") as f:
+            data = f.read()
+        good = 0
+        for off, payload in iter_frames(data):
+            rec = json.loads(payload)
+            if rec.get("op") == "autopilot":
+                out.append(rec)
+            good = off + _FRAME.size + len(payload)
+        if good < len(data):
+            break  # torn here: later segments are unreachable
+    out.sort(key=lambda r: int(r.get("lsn", 0)))
+    return out

@@ -45,6 +45,8 @@ SITES = frozenset({
     "autopilot.decide",      # the controller evaluating one policy tick
     "shard.split",           # the plane starting a split-off shard
     "shard.migrate",         # the two-phase cross-shard rank handoff
+    "sim.event",             # fleetsim dispatching one queued event
+    "sim.inject",            # fleetsim applying a scenario injection
 })
 
 #: what a firing rule does (interpreted by runtime.perform / the sites)

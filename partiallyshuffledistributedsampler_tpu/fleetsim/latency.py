@@ -1,0 +1,173 @@
+"""Seeded latency models calibrated from the recorded BENCH_r0*.json runs.
+
+Two surfaces (docs/SIMULATOR.md):
+
+* :class:`LatencyModel` — per-channel lognormal samplers for the four
+  delays the serving plane actually pays: ``rpc`` (request dispatch +
+  service), ``regen`` (per-epoch index regeneration), ``wal_fsync``
+  (durability group-commit), ``barrier`` (reshard freeze + drain).
+  Sampling is ``random.Random``-seeded per ``(seed, channel)``, so a
+  channel's stream is independent of how often the others are drawn —
+  adding a WAL sample never perturbs the rpc timeline.
+* :class:`RegenCostModel` — the per-backend regen cost lines in exactly
+  the shape ``utils/autotune.cost_model()`` measures (``host_fixed_ms +
+  host_rate_ms*n`` vs ``dev_fixed_ms + dev_rate_ms*n``), so the
+  simulator proves the autopilot's ``backend_pick`` arm against the
+  same decision function the live controller uses, without paying the
+  seconds-expensive jit probe.
+
+Calibration: the defaults below are medians read off the committed
+``BENCH_r01..r05`` tails (``extra_eager_dispatch_ms`` ≈ 0.17–0.28 for
+dispatch, ``boundary_dispatch_ms`` ≈ 1.6–2.1 for the fsync-class
+boundary cost, ``regen_completed_ms`` ≈ 108–124 for a full async regen,
+``steady_noise_ms_per_step`` ≈ 0.02–0.26 for jitter).
+:func:`Calibration.from_bench` re-derives them from whatever
+``BENCH_r0*.json`` files are present, falling back to these constants
+per channel when a run recorded no matching samples.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import random
+import re
+from dataclasses import dataclass, replace
+from pathlib import Path
+from typing import Optional
+
+#: the delay families the simulator models, in documentation order
+CHANNELS = ("rpc", "regen", "wal_fsync", "barrier")
+
+#: BENCH-tail keys that calibrate each channel's median
+_BENCH_KEYS = {
+    "rpc": "extra_eager_dispatch_ms",
+    "regen": "regen_completed_ms",
+    "wal_fsync": "boundary_dispatch_ms",
+}
+
+
+@dataclass(frozen=True)
+class Calibration:
+    """Per-channel ``(p50_ms, sigma)`` of a lognormal delay model."""
+
+    rpc: tuple = (0.25, 0.35)
+    regen: tuple = (110.0, 0.10)
+    wal_fsync: tuple = (1.9, 0.25)
+    #: no bench histogram exists for barriers; seeded from the typed
+    #: backpressure hints (reshard_freeze 20 ms + drain headroom)
+    barrier: tuple = (25.0, 0.20)
+
+    @classmethod
+    def from_bench(cls, root) -> "Calibration":
+        """Best-effort recalibration from ``BENCH_r0*.json`` under
+        ``root``: each file stores the bench stdout tail, so the known
+        per-channel keys are regex-scraped and the median becomes that
+        channel's p50.  Channels with no samples keep the defaults."""
+        out = cls()
+        tails = []
+        for p in sorted(Path(root).glob("BENCH_r0*.json")):
+            try:
+                tails.append(str(json.loads(p.read_text()).get("tail", "")))
+            except (OSError, ValueError):
+                continue
+        text = "\n".join(tails)
+        for chan, key in _BENCH_KEYS.items():
+            vals = [float(v) for v in re.findall(
+                rf'"{key}":\s*([0-9]+(?:\.[0-9]+)?)', text)]
+            vals = [v for v in vals if v > 0.0]
+            if vals:
+                vals.sort()
+                p50 = vals[len(vals) // 2]
+                out = replace(out, **{chan: (p50, getattr(out, chan)[1])})
+        return out
+
+
+class LatencyModel:
+    """Seeded per-channel lognormal delay sampler.
+
+        lat = LatencyModel(seed=7)
+        lat.sample("rpc")       # ms, deterministic stream per channel
+        lat.p99("regen")        # closed-form lognormal p99
+
+    A lognormal keeps every sample positive and gives the long right
+    tail real service latencies show; ``p50`` anchors the median and
+    ``sigma`` the spread (p99 ≈ p50·e^{2.326σ}).
+    """
+
+    def __init__(self, seed: int = 0,
+                 calibration: Optional[Calibration] = None) -> None:
+        self.seed = int(seed)
+        self.calibration = calibration if calibration is not None \
+            else Calibration()
+        self._rngs = {c: random.Random(f"fleetsim:{self.seed}:{c}")
+                      for c in CHANNELS}
+
+    def params(self, channel: str) -> tuple:
+        try:
+            return getattr(self.calibration, channel)
+        except AttributeError:
+            raise KeyError(
+                f"unknown latency channel {channel!r}; channels are "
+                f"{list(CHANNELS)}") from None
+
+    def sample(self, channel: str) -> float:
+        """One delay in ms from ``channel``'s seeded stream."""
+        p50, sigma = self.params(channel)
+        g = self._rngs[channel].gauss(0.0, 1.0)
+        return float(p50) * math.exp(float(sigma) * g)
+
+    def p50(self, channel: str) -> float:
+        return float(self.params(channel)[0])
+
+    def p99(self, channel: str) -> float:
+        """Closed-form lognormal p99 (z_{0.99} = 2.326)."""
+        p50, sigma = self.params(channel)
+        return float(p50) * math.exp(2.326 * float(sigma))
+
+
+@dataclass(frozen=True)
+class RegenCostModel:
+    """Per-backend regen cost lines, shaped like ``autotune.cost_model()``.
+
+    Defaults put the host/device crossover near 1M samples per rank —
+    the regime the committed BENCH torch tiers show (host ``native``
+    wins the small-``n/world`` shapes, the device line's flat dispatch
+    cost amortizes out on huge ones).  ``pick`` reproduces the exact
+    comparison ``utils/autotune.pick_backend`` performs, plus the gain
+    margin the predictive policy's backend arm thresholds on.
+    """
+
+    host_backend: str = "native"
+    host_fixed_ms: float = 0.05
+    host_rate_ms: float = 2.0e-6      # 2 ns/sample ≈ 2 ms per 1M indices
+    dev_fixed_ms: float = 2.0         # jit dispatch + fetch floor
+    dev_rate_ms: float = 1.0e-9       # device line is nearly flat
+
+    def estimate_ms(self, backend: str, num_samples: int) -> float:
+        n = max(0, int(num_samples))
+        if backend == "xla":
+            return self.dev_fixed_ms + self.dev_rate_ms * n
+        return self.host_fixed_ms + self.host_rate_ms * n
+
+    def pick(self, num_samples: int) -> tuple:
+        """``(backend, gain_pct, info)`` for a per-rank epoch of
+        ``num_samples`` indices; ``info`` matches the live probe's
+        shape (est_host_ms / est_device_ms / picked)."""
+        est_host = self.estimate_ms(self.host_backend, num_samples)
+        est_dev = self.estimate_ms("xla", num_samples)
+        backend = "xla" if est_dev < est_host else self.host_backend
+        worse, best = max(est_host, est_dev), min(est_host, est_dev)
+        gain_pct = 0.0 if worse <= 0.0 else 100.0 * (worse - best) / worse
+        info = {
+            "host_backend": self.host_backend,
+            "host_fixed_ms": self.host_fixed_ms,
+            "host_rate_ms": self.host_rate_ms,
+            "dev_fixed_ms": self.dev_fixed_ms,
+            "dev_rate_ms": self.dev_rate_ms,
+            "est_host_ms": est_host,
+            "est_device_ms": est_dev,
+            "num_samples": int(num_samples),
+            "picked": backend,
+        }
+        return backend, float(gain_pct), info

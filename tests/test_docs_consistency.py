@@ -29,6 +29,7 @@ _NAMESPACES = (
     "partiallyshuffledistributedsampler_tpu.service",
     "partiallyshuffledistributedsampler_tpu.sharding",
     "partiallyshuffledistributedsampler_tpu.autopilot",
+    "partiallyshuffledistributedsampler_tpu.fleetsim",
     "partiallyshuffledistributedsampler_tpu.capability",
     "partiallyshuffledistributedsampler_tpu.streaming",
     "partiallyshuffledistributedsampler_tpu.telemetry",
@@ -420,4 +421,51 @@ def test_autopilot_doc_cross_linked():
 
     res = (DOCS / "RESILIENCE.md").read_text()
     for site in ("autopilot.decide", "shard.split", "shard.migrate"):
+        assert site in F.SITES and site in res
+
+
+def test_simulator_doc_cross_linked():
+    """The fleet simulator is documented where an operator would look:
+    docs/SIMULATOR.md owns the event/latency/trace/replay story (and
+    the make gate), AUTOPILOT.md / SHARDING.md / ARCHITECTURE.md /
+    RESILIENCE.md / OBSERVABILITY.md and README.md link to it, API.md
+    documents the public surface, every ``sim_*`` metric the simulator
+    counts is in the OBSERVABILITY.md inventory, and the documented
+    fault sites are the registered ones."""
+    simulator_md = DOCS / "SIMULATOR.md"
+    assert simulator_md.exists()
+    text = simulator_md.read_text()
+    for token in ("FleetSim", "AutopilotPolicy", "BackpressurePolicy",
+                  "ShardMap", "SimClock", "EventLoop", "DecisionTrace",
+                  "LatencyModel", "Calibration.from_bench",
+                  "RegenCostModel", "byte-identical", "wal_records",
+                  "verify_replay", "read_autopilot_records",
+                  "sim-smoke", "sim.event", "sim.inject",
+                  "map_fingerprint"):
+        assert token in text, f"docs/SIMULATOR.md lost `{token}`"
+    for doc in ("AUTOPILOT.md", "SHARDING.md", "ARCHITECTURE.md",
+                "RESILIENCE.md", "OBSERVABILITY.md"):
+        assert "SIMULATOR.md" in (DOCS / doc).read_text(), (
+            f"docs/{doc} lost its cross-link to docs/SIMULATOR.md")
+    assert "docs/SIMULATOR.md" in (DOCS.parent / "README.md").read_text()
+    api = API_MD.read_text()
+    for token in ("FleetSim(*, world, n_shards, n, workload",
+                  "LatencyModel", "Calibration", "RegenCostModel",
+                  "DecisionTrace", "SimClock", "EventLoop", "Workload",
+                  "backend_probe", "observe=", "learn_priors",
+                  "warm_state"):
+        assert token in api, f"docs/API.md lost the fleetsim surface `{token}`"
+    obs = OBSERVABILITY_MD.read_text()
+    for token in ("sim_events", "sim_event_faults", "sim_ticks",
+                  "sim_decisions", "sim_tunes", "sim_sheds",
+                  "sim_backend_picks", "sim_splits", "sim_merges",
+                  "sim_migrations", "sim_drills", "sim_injected",
+                  "sim_inject_faults", "sim_actuation_errors"):
+        assert token in obs, (
+            f"docs/OBSERVABILITY.md lost the simulator metric `{token}`")
+    # the documented fault sites must be the registered ones
+    from partiallyshuffledistributedsampler_tpu import faults as F
+
+    res = (DOCS / "RESILIENCE.md").read_text()
+    for site in ("sim.event", "sim.inject"):
         assert site in F.SITES and site in res

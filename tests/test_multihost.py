@@ -14,6 +14,11 @@ import textwrap
 
 import pytest
 
+#: the exact XLA error a CPU-only jaxlib raises for any multi-process
+#: computation — the ONE failure this suite converts into a skip
+_CPU_MULTIPROCESS_UNSUPPORTED = (
+    "Multiprocess computations aren't implemented on the CPU backend.")
+
 _WORKER = textwrap.dedent("""
     import os, sys
     pid = int(sys.argv[1]); port = sys.argv[2]
@@ -106,6 +111,17 @@ def test_two_process_mesh(tmp_path):
             pytest.fail("multihost workers timed out")
         outs.append((p.returncode, out, err))
     for rc, out, err in outs:
+        if rc != 0 and _CPU_MULTIPROCESS_UNSUPPORTED in err:
+            # Known environment limitation, NOT a regression: this
+            # jaxlib's CPU collectives cannot run a multi-process
+            # computation (the real target is a multi-host TPU pod).
+            # Guarded on the exact XLA error string so any OTHER
+            # failure — a real cross-process regen regression — still
+            # fails the suite loudly.
+            pytest.skip(
+                "jax.distributed two-process mesh unsupported here: "
+                f"{_CPU_MULTIPROCESS_UNSUPPORTED!r} (CPU-only jaxlib; "
+                "needs a multi-host-capable backend)")
         assert rc == 0, f"worker failed:\n{err[-3000:]}"
         assert "MULTIHOST_OK" in out
     # between them the two processes validated all 8 rows
