@@ -70,6 +70,12 @@
 #                          exactly-once, online re-weighting, bounded
 #                          WAL state, advance-barrier failover, then
 #                          the streaming-within-frozen-noise bar
+#   * sampling smoke       tests/test_sampling.py (`-m sampling`)
+#                          + benchmarks/sampling_smoke.py — non-uniform
+#                          workload classes: weighted/prioritized/dedup
+#                          bit-identity across all serve paths, reshard
+#                          + failover union laws, then the weighted-
+#                          regen-within-uniform-noise bar
 #   * autopilot smoke      tests/test_autopilot.py (`-m autopilot`)
 #                          + benchmarks/autopilot_smoke.py — closed-loop
 #                          self-tuning: knob-arm convergence on BASELINE
@@ -96,7 +102,8 @@ PY ?= python
 .PHONY: check test bench native dryrun service-smoke chaos-smoke \
 	elastic-smoke telemetry-smoke failover-smoke tenancy-smoke \
 	durability-smoke fused-smoke sharding-smoke capability-smoke \
-	streaming-smoke autopilot-smoke sim-smoke analyze analysis-smoke
+	streaming-smoke sampling-smoke autopilot-smoke sim-smoke analyze \
+	analysis-smoke
 
 # the driver parses the LAST line of bench.py's combined output (round 3
 # lost its headline to the details line — BENCH_r03.json "parsed": null),
@@ -201,6 +208,15 @@ capability-smoke:
 streaming-smoke:
 	$(PY) -m pytest tests/test_streaming.py -q -m streaming -ra
 	$(PY) benchmarks/streaming_smoke.py
+
+# sampling gate (docs/SAMPLING.md): the weighted/prioritized/dedup
+# suite (alias-table and statistical laws, CPU-vs-device bit-identity,
+# weights_delta folds on every serve path, dedup union across reshard
+# + failover, snapshot-boundary recovery), then the weighted-regen
+# within the uniform kernel's noise bar
+sampling-smoke:
+	$(PY) -m pytest tests/test_sampling.py -q -m sampling -ra
+	$(PY) benchmarks/sampling_smoke.py
 
 # autopilot gate (docs/AUTOPILOT.md): policy determinism/convergence,
 # elastic split/merge/migrate bit-identity, WAL-replayed controller

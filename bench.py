@@ -495,6 +495,22 @@ def main() -> None:
         except Exception as exc:
             details["streaming_error"] = repr(exc)[:200]
 
+    # detail tier: sampling — weighted alias-kernel regen vs the
+    # uniform kernel at the same T (the alias select + within-source
+    # draw must disappear into the uniform arm's own rep noise), plus
+    # the dedup fold's informational wall (methodology in
+    # benchmarks/sampling_smoke.py)
+    if not smoke:
+        try:
+            sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+            from benchmarks.sampling_smoke import (
+                summarize as sampling_summarize,
+            )
+
+            details["sampling"] = sampling_summarize()
+        except Exception as exc:
+            details["sampling_error"] = repr(exc)[:200]
+
     # detail tier: autopilot — knob-arm convergence on the BASELINE
     # workload shapes, the controller-driven split drill (bit-identity
     # hard-asserted inside), and the calm-controller idle-overhead bar

@@ -1,0 +1,146 @@
+"""Sampling smoke: weighted regen must cost what uniform regen costs.
+
+Two consumers:
+
+* ``make sampling-smoke`` / ``python benchmarks/sampling_smoke.py``
+  — the CI gate: regenerate the same number of per-epoch indices
+  through two arms — the uniform windowed permutation
+  (``PartialShuffleSpec.plain``) vs the importance-weighted alias
+  kernel (``SamplingSpec.weighted``) at the same ``T`` — and assert
+  the weighted arm's per-epoch wall within the uniform arm's own
+  rep-to-rep noise.  Exit 0 and one JSON line on success; raises
+  loudly otherwise.
+
+* ``bench.py`` imports :func:`summarize` for ``details["sampling"]``.
+
+Methodology: both arms regenerate ``REPS`` epochs of ``T`` samples at
+rank 0 / world 1 on the CPU twin (the normative derivation both
+backends must match bit-for-bit — tests/test_sampling.py).  The
+uniform arm's per-epoch walls past warmup give the noise band
+(max - min); the weighted arm's median must land within it above the
+uniform median — the alias select, the within-source hash draw and
+the per-source swap_or_not ride the same O(T) shape, so any
+structural regression (a table rebuilt per batch, a float sneaking
+into the accept test) surfaces as a wall gap, not a unit-test
+failure (docs/SAMPLING.md "Observability and the gate").  The dedup
+fold is reported informationally (``dedup_wall_ms_per_epoch``): its
+seen-set probes are inherently O(T) host work on top of the kernel,
+so it carries no noise-band bar.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+#: epochs per arm; the first is warmup (table build, allocator churn)
+REPS = 6
+
+
+def _split_sizes(n: int) -> tuple:
+    """Three consecutive source blocks covering ``[0, n)``."""
+    a, b = n // 2, n // 3
+    return (a, b, n - a - b)
+
+
+def _uniform_arm(T: int, window: int):
+    from partiallyshuffledistributedsampler_tpu.service import (
+        PartialShuffleSpec,
+    )
+
+    spec = PartialShuffleSpec.plain(T, window=window, seed=0, world=1)
+    walls = []
+    for e in range(REPS):
+        t0 = time.perf_counter()
+        idx = spec.rank_indices(e, 0)
+        walls.append((time.perf_counter() - t0) * 1e3)
+        assert len(idx) == T, (e, len(idx))
+    return walls
+
+
+def _weighted_arm(T: int, window: int):
+    from partiallyshuffledistributedsampler_tpu.sampling import SamplingSpec
+
+    spec = SamplingSpec.weighted(_split_sizes(T), (3, 1, 2),
+                                 epoch_samples=T, window=window,
+                                 seed=0, world=1)
+    walls = []
+    for e in range(REPS):
+        t0 = time.perf_counter()
+        idx = spec.rank_indices(e, 0)
+        walls.append((time.perf_counter() - t0) * 1e3)
+        assert len(idx) == T, (e, len(idx))
+        assert int(np.min(idx)) >= 0 and int(np.max(idx)) < T
+    return walls
+
+
+def _dedup_arm(T: int, window: int, epochs: int = 3):
+    """Informational: the seen-set fold's wall per epoch, id space 4T
+    so ``epochs`` epochs never approach saturation."""
+    from partiallyshuffledistributedsampler_tpu.sampling import SamplingSpec
+
+    spec = SamplingSpec.deduped(_split_sizes(4 * T), epoch_samples=T,
+                                window=window, seed=0, world=1)
+    walls, served = [], []
+    for e in range(epochs):
+        t0 = time.perf_counter()
+        idx = spec.rank_indices(e, 0)
+        walls.append((time.perf_counter() - t0) * 1e3)
+        served.append(np.asarray(idx))
+    union = np.concatenate(served)
+    if len(set(union.tolist())) != len(union):
+        raise AssertionError(
+            "dedup fold re-served an id across epochs — the no-repeat "
+            "law broke (docs/SAMPLING.md)")
+    return walls
+
+
+def summarize(*, T: int = None, window: int = 64) -> dict:
+    """Uniform vs weighted per-epoch regen wall at the same ``T`` —
+    the ``details["sampling"]`` tier."""
+    if T is None:
+        T = (4096 if os.environ.get("PSDS_BENCH_SMOKE") else 16384)
+
+    uniform_walls = _uniform_arm(T, window)
+    weighted_walls = _weighted_arm(T, window)
+    dedup_walls = _dedup_arm(T, window)
+
+    # first-epoch warmup belongs to both arms equally; the noise band
+    # is the uniform arm's own rep spread past warmup
+    uniform = sorted(uniform_walls[1:])
+    uniform_med = uniform[len(uniform) // 2]
+    noise = max(uniform) - min(uniform)
+    weighted = sorted(weighted_walls[1:])
+    weighted_med = weighted[len(weighted) // 2]
+
+    within = bool(weighted_med <= uniform_med + max(noise, 0.5))
+    return {
+        "T": T, "window": window, "reps": REPS,
+        "uniform_wall_ms_per_epoch": round(uniform_med, 3),
+        "uniform_noise_ms": round(noise, 3),
+        "weighted_wall_ms_per_epoch": round(weighted_med, 3),
+        "dedup_wall_ms_per_epoch": round(
+            sorted(dedup_walls)[len(dedup_walls) // 2], 3),
+        "weighted_within_noise": within,
+    }
+
+
+def main() -> None:
+    """The `make sampling-smoke` gate: hard assertions, one JSON line."""
+    report = summarize()
+    assert report["weighted_within_noise"], (
+        f"weighted regen {report['weighted_wall_ms_per_epoch']}ms/epoch "
+        f"fell out of the uniform arm's noise "
+        f"({report['uniform_wall_ms_per_epoch']}ms "
+        f"± {report['uniform_noise_ms']}ms): {report!r}")
+    print(json.dumps({"sampling_smoke": "ok", **report}))
+
+
+if __name__ == "__main__":
+    main()

@@ -79,6 +79,11 @@ _DOC_TOKEN_PASSTHROUGH = frozenset({
     "capability_heartbeat_s", "membership_stream", "target_samples",
     # autopilot kwarg vocabulary (docs/AUTOPILOT.md)
     "drill_interval_s", "batch_hint", "drill_max_lag_ms",
+    # sampling-mode telemetry event names documented next to the
+    # `sampling_reweights` counter (docs/SAMPLING.md) — events, not
+    # registry entries
+    "sampling_alias_fallback", "sampling_dedup_failsafe",
+    "sampling_dedup_saturated",
     # smoke-report fields the docs quote next to the metric tables
     "steady_noise_ms_per_step", "sanitize_overhead_within_noise",
 })

@@ -30,6 +30,14 @@ def workload_key(spec) -> str:
     share a key — and therefore share warm starts."""
     n = int(getattr(spec, "n", 0) or 0)
     world = max(1, int(getattr(spec, "world", 1) or 1))
+    mode = getattr(spec, "sampling_mode", None)
+    if mode is not None:
+        # non-uniform sampling kernels have their own regen/serve cost
+        # shapes (docs/SAMPLING.md): a dedup fold's knobs must never
+        # warm-start a uniform deployment of the same n/world, and vice
+        # versa.  Uniform keys keep their historical form — every
+        # recorded prior table stays valid.
+        return f"n{n}:w{world}:s{mode}"
     return f"n{n}:w{world}"
 
 

@@ -42,6 +42,8 @@ SITES = frozenset({
     "capability.verify",     # a client verifying a received capability
     "stream.append",         # a feeder APPEND extending the index space
     "stream.advance",        # the ack-gated horizon-advance barrier
+    "sampling.alias_build",  # building an epoch's weighted alias table
+    "sampling.dedup_check",  # one seen-set membership test of a draw
     "autopilot.decide",      # the controller evaluating one policy tick
     "shard.split",           # the plane starting a split-off shard
     "shard.migrate",         # the two-phase cross-shard rank handoff

@@ -67,7 +67,8 @@ class FleetSim:
                  latency: Optional[LatencyModel] = None,
                  regen_cost: Optional[RegenCostModel] = None,
                  interval_s: float = 1.0, batch0: int = 1024,
-                 backend: str = "native") -> None:
+                 backend: str = "native",
+                 sampling_mode: Optional[str] = None) -> None:
         self.world = int(world)
         self.n = int(n)
         self.workload = workload
@@ -90,6 +91,10 @@ class FleetSim:
         self.batch = int(batch0)
         self.max_inflight = int(self.policy.config.min_inflight)
         self.backend = str(backend)
+        #: non-uniform sampling mode of the simulated workload
+        #: (docs/SAMPLING.md) — shifts the regen cost lines (the dedup
+        #: fold is host-side work) and the priors' workload key
+        self.sampling_mode = sampling_mode
         self.ticks = 0
         self.window_stats: dict = {}   # sid -> last window's fluid state
         self._backlog: dict = {}       # sid -> carried retry backlog (rpcs)
@@ -172,7 +177,8 @@ class FleetSim:
             regen_noise = self.latency.sample("regen") \
                 / self.latency.p50("regen")
             regen_ms = self.regen_cost.estimate_ms(
-                self.backend, self.per_rank) * regen_noise
+                self.backend, self.per_rank,
+                sampling_mode=self.sampling_mode) * regen_noise
             svc_ms = rpc_ms + regen_ms * self.batch / self.per_rank \
                 + 0.1 * wal_ms
             cap_w = self.max_inflight * window_ms / svc_ms \
@@ -211,7 +217,8 @@ class FleetSim:
                "max_inflight": int(self.max_inflight),
                "shards": shards, "workload": self.workload.key}
         if self.policy.config.backend_pick:
-            cand, gain_pct, _ = self.regen_cost.pick(self.per_rank)
+            cand, gain_pct, _ = self.regen_cost.pick(
+                self.per_rank, sampling_mode=self.sampling_mode)
             obs["backend_current"] = self.backend
             obs["backend_candidate"] = cand
             obs["backend_gain_pct"] = gain_pct

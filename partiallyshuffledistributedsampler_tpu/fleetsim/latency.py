@@ -143,19 +143,45 @@ class RegenCostModel:
     host_rate_ms: float = 2.0e-6      # 2 ns/sample ≈ 2 ms per 1M indices
     dev_fixed_ms: float = 2.0         # jit dispatch + fetch floor
     dev_rate_ms: float = 1.0e-9       # device line is nearly flat
+    #: per-sampling-mode multipliers on the per-sample rate lines
+    #: (docs/SAMPLING.md): the weighted kernel replaces the uniform
+    #: outer+inner permutation chains with three hash draws plus one
+    #: within-window chain — at or slightly below the uniform cost on
+    #: both lines (the sampling-smoke noise-band criterion), so the
+    #: multiplier is 1.0; ``prioritized`` is the same kernel with a
+    #: different table.  The dedup fold is a HOST-side sequential walk
+    #: (~0.5 µs/draw seen-set bookkeeping on top of the vectorised base
+    #: draws), so its host rate dominates and the device line gains
+    #: nothing — without this term ``backend_pick`` would misprice
+    #: dedup regen as device-cheap by orders of magnitude.
+    weighted_rate_mult: float = 1.0
+    dedup_host_rate_ms: float = 5.0e-4   # ~0.5 µs per folded draw
 
-    def estimate_ms(self, backend: str, num_samples: int) -> float:
+    def estimate_ms(self, backend: str, num_samples: int,
+                    sampling_mode: Optional[str] = None) -> float:
         n = max(0, int(num_samples))
+        mult = (self.weighted_rate_mult
+                if sampling_mode in ("weighted", "prioritized") else 1.0)
+        if sampling_mode == "dedup":
+            # the fold is host-resident regardless of backend: the
+            # device accelerates only the base draws
+            return (self.host_fixed_ms + self.host_rate_ms * n
+                    + self.dedup_host_rate_ms * n)
         if backend == "xla":
-            return self.dev_fixed_ms + self.dev_rate_ms * n
-        return self.host_fixed_ms + self.host_rate_ms * n
+            return self.dev_fixed_ms + self.dev_rate_ms * mult * n
+        return self.host_fixed_ms + self.host_rate_ms * mult * n
 
-    def pick(self, num_samples: int) -> tuple:
+    def pick(self, num_samples: int,
+             sampling_mode: Optional[str] = None) -> tuple:
         """``(backend, gain_pct, info)`` for a per-rank epoch of
         ``num_samples`` indices; ``info`` matches the live probe's
-        shape (est_host_ms / est_device_ms / picked)."""
-        est_host = self.estimate_ms(self.host_backend, num_samples)
-        est_dev = self.estimate_ms("xla", num_samples)
+        shape (est_host_ms / est_device_ms / picked).
+        ``sampling_mode`` prices the non-uniform kernels: dedup regen
+        pins to the host line (the fold is sequential there), so the
+        device arm can never look spuriously attractive for it."""
+        est_host = self.estimate_ms(self.host_backend, num_samples,
+                                    sampling_mode)
+        est_dev = self.estimate_ms("xla", num_samples, sampling_mode)
         backend = "xla" if est_dev < est_host else self.host_backend
         worse, best = max(est_host, est_dev), min(est_host, est_dev)
         gain_pct = 0.0 if worse <= 0.0 else 100.0 * (worse - best) / worse
@@ -170,4 +196,6 @@ class RegenCostModel:
             "num_samples": int(num_samples),
             "picked": backend,
         }
+        if sampling_mode is not None:
+            info["sampling_mode"] = str(sampling_mode)
         return backend, float(gain_pct), info

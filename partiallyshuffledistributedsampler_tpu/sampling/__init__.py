@@ -1,0 +1,50 @@
+"""Non-uniform sampling as first-class workload classes.
+
+Three sampling modes — ``weighted`` (static importance weights via an
+exact-integer alias table), ``prioritized`` (per-epoch additive
+re-weighting through the ``weights_delta`` path), and ``dedup``
+(deterministic seeded seen-set suppressing repeats across epochs) —
+packaged as :class:`SamplingSpec`, a drop-in
+:class:`~..service.spec.PartialShuffleSpec`.  Because the spec value
+object owns the whole derivation, every existing consumer surface
+(served batches, capability local regen, degraded fallback, elastic
+reshard, failover replay) serves these streams bit-identically with no
+new protocol machinery.  See docs/SAMPLING.md.
+"""
+
+from .alias import (
+    AliasTable,
+    build_alias_table,
+    weighted_elastic_indices_jax,
+    weighted_elastic_indices_np,
+    weighted_epoch_indices_jax,
+    weighted_epoch_indices_np,
+    weighted_stream_at_generic,
+)
+from .dedup import (
+    BloomSeen,
+    ExactSeen,
+    dedup_check,
+    fold_epoch,
+    make_seen,
+    restore_seen,
+)
+from .spec import SAMPLING_MODES, SamplingSpec
+
+__all__ = [
+    "AliasTable",
+    "BloomSeen",
+    "ExactSeen",
+    "SAMPLING_MODES",
+    "SamplingSpec",
+    "build_alias_table",
+    "dedup_check",
+    "fold_epoch",
+    "make_seen",
+    "restore_seen",
+    "weighted_elastic_indices_jax",
+    "weighted_elastic_indices_np",
+    "weighted_epoch_indices_jax",
+    "weighted_epoch_indices_np",
+    "weighted_stream_at_generic",
+]

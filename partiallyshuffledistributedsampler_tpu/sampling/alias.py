@@ -1,0 +1,433 @@
+"""Importance-weighted window sampling: exact-integer alias tables.
+
+The weighted stream maps draw ordinals ``p`` to global sample ids in
+one O(1) random-access step, exactly like the windowed permutation maps
+positions to indices — no cumulative tables, no rejection loops, no
+state.  Three hash draws per lane decide everything:
+
+* a **column** draw picks one of the ``S`` alias columns uniformly;
+* an **accept** draw against the column's integer threshold keeps the
+  column or takes its alias — the classic Walker/Vose construction,
+  built here in exact python-int arithmetic so the acceptance law is
+  ``P(source s) = mass_s / total`` with no floating-point round-off and
+  therefore no CPU/XLA drift;
+* a **local** draw places the sample inside the chosen source, and the
+  within-window offset is then passed through the same ``swap_or_not``
+  bijection the windowed permutation uses (``core.inner_key`` /
+  ``core.inner_pair_key``), so weighted draws share the kernel stack's
+  window structure instead of inventing a second shuffle.
+
+Every step is uint32/uint64 xor-shift-multiply-mod — the mixture
+kernel's recipe for bit-identical numpy and XLA evaluation — and the
+table itself is static python data, so the jitted frontend compiles
+once per ``(table, world, flags)`` and traces ``epoch``/``rank``.
+
+Degenerate tables are exact by construction: uniform weights give every
+column threshold ``total`` (always accept — the column draw IS the
+source draw), and a one-hot weight vector gives zero-mass columns a
+zero threshold (never accepted; their alias points at the hot source).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..ops import core
+
+__all__ = [
+    "AliasTable", "build_alias_table",
+    "weighted_stream_at_generic",
+    "weighted_epoch_indices_generic", "weighted_elastic_indices_generic",
+    "weighted_epoch_indices_np", "weighted_elastic_indices_np",
+    "weighted_epoch_indices_jax", "weighted_elastic_indices_jax",
+]
+
+#: unroll per-column select chains up to here; gather above (the
+#: mixture kernel's _SELECT_CAP split, same rationale)
+_SELECT_CAP = 8
+
+#: columns cap — the table rides the spec wire form and the kernel
+#: unrolls/gathers per column, so S is a config knob, not a data axis
+_MAX_SOURCES = 4096
+
+# round constants for the per-ordinal hash streams (disjoint from the
+# core key-schedule constants; same murmur-style vocabulary)
+_C_POS = 0x7FEB352D
+_C_POSH = 0x846CA68B
+_C_SEL = 0x9E485565
+_C_ACC = 0xAF36D01E
+_C_ACC2 = 0x4A7B92D5
+_C_LOC = 0x6C62272E
+_C_LOC2 = 0x35A4E1B1
+_C_SRC = 0xB5297A4D
+_C_RETRY = 0x68E31DA4
+
+_I31 = 0x7FFFFFFF
+_I63 = 0x7FFFFFFFFFFFFFFF
+
+
+@dataclass(frozen=True)
+class AliasTable:
+    """One Walker/Vose alias table in exact integer arithmetic.
+
+    ``probs[j]`` is column ``j``'s acceptance threshold in
+    ``[0, total]`` (``total`` = the exact mass sum): an accept draw
+    ``u ~ U[0, total)`` keeps ``j`` iff ``u < probs[j]``, else takes
+    ``alias[j]``.  ``masses`` records the per-source masses the table
+    encodes, so a table is self-describing for tests and cost models.
+    """
+
+    probs: tuple
+    alias: tuple
+    total: int
+    masses: tuple
+
+    def key(self) -> tuple:
+        """Hashable identity for compiled-frontend caches."""
+        return (self.probs, self.alias, self.total)
+
+
+def build_alias_table(weights, weight_kind: str,
+                      source_sizes) -> AliasTable:
+    """Build the exact-integer alias table for ``weights`` over
+    ``source_sizes``.
+
+    ``weight_kind='per_source'`` gives source ``s`` total mass ``w_s``
+    (a small source is oversampled per sample); ``'per_sample'`` gives
+    mass ``w_s * n_s`` (every sample of source ``s`` carries weight
+    ``w_s``).  Weights are non-negative integer quotas like the mixture
+    kernel's; at least one must be positive.  Pure and deterministic —
+    the small/large pairing walks ascending column order.
+    """
+    sizes = tuple(int(n) for n in source_sizes)
+    if not sizes:
+        raise ValueError("source_sizes must name at least one source")
+    if len(sizes) > _MAX_SOURCES:
+        raise ValueError(
+            f"at most {_MAX_SOURCES} sources, got {len(sizes)}")
+    if any(n < 1 for n in sizes):
+        raise ValueError(f"source sizes must be >= 1, got {sizes}")
+    w = tuple(int(x) for x in weights)
+    if len(w) != len(sizes):
+        raise ValueError(
+            f"{len(w)} weights for {len(sizes)} sources")
+    if any(x < 0 for x in w):
+        raise ValueError(f"weights must be >= 0, got {w}")
+    if weight_kind == "per_source":
+        masses = w
+    elif weight_kind == "per_sample":
+        masses = tuple(x * n for x, n in zip(w, sizes))
+    else:
+        raise ValueError(
+            f"weight_kind must be 'per_source' or 'per_sample', "
+            f"got {weight_kind!r}")
+    total = sum(masses)
+    if total <= 0:
+        raise ValueError("weights sum to zero mass; nothing to sample")
+    # canonicalize by the GCD: only the mass RATIOS are the sampling
+    # identity, so proportional weights must build the IDENTICAL table
+    # (and therefore the identical stream — scale invariance)
+    g = 0
+    for m in masses:
+        g = math.gcd(g, m)
+    if g > 1:
+        masses = tuple(m // g for m in masses)
+        total //= g
+    S = len(masses)
+    if total > _I63 // max(S, 1):
+        raise ValueError("total sampling mass too large (>= 2^63 / S)")
+    # Vose in python ints: scale each mass by S so the per-column
+    # average is exactly ``total``; the pairing conserves the scaled sum
+    # so when one stack drains the other's leftovers all equal ``total``
+    scaled = [m * S for m in masses]
+    probs = [total] * S
+    alias = list(range(S))
+    small = [j for j in range(S) if scaled[j] < total]
+    large = [j for j in range(S) if scaled[j] >= total]
+    while small and large:
+        s, l = small.pop(), large.pop()
+        probs[s] = scaled[s]
+        alias[s] = l
+        scaled[l] -= total - scaled[s]
+        (small if scaled[l] < total else large).append(l)
+    return AliasTable(probs=tuple(probs), alias=tuple(alias),
+                      total=int(total), masses=masses)
+
+
+# ------------------------------------------------------------- lane math
+def _lane(xp, idx, values, dtype):
+    """``values[idx]`` per lane: an unrolled select chain for small
+    tables (VPU-friendly, no gather), ``xp.take`` above the cap — both
+    exact, so the split is a pure speed knob."""
+    vals = tuple(values)
+    if len(vals) > _SELECT_CAP:
+        return xp.take(xp.asarray(np.asarray(vals, dtype=dtype)), idx)
+    out = xp.full_like(idx, vals[0], dtype=dtype)
+    for s in range(1, len(vals)):
+        out = xp.where(idx == xp.asarray(np.uint32(s)),
+                       xp.asarray(np.asarray(vals[s], dtype=dtype)), out)
+    return out
+
+
+def _u32c(xp, v):
+    return xp.asarray(np.uint32(v & 0xFFFFFFFF))
+
+
+def _draw64(xp, base, c_hi: int, c_lo: int, modulus: int):
+    """A 64-bit hash draw mod ``modulus`` (uint64 lanes; needs x64
+    under jax — the frontends guard)."""
+    hi = core.mix32(xp, base ^ _u32c(xp, c_hi)).astype(xp.uint64)
+    lo = core.mix32(xp, base ^ _u32c(xp, c_lo)).astype(xp.uint64)
+    u = (hi << xp.asarray(np.uint64(32))) | lo
+    return u % xp.asarray(np.uint64(modulus))
+
+
+def weighted_stream_at_generic(
+    xp,
+    positions,
+    table: AliasTable,
+    source_sizes,
+    seed,
+    epoch,
+    *,
+    window: int,
+    shuffle: bool = True,
+    rounds: int = core.DEFAULT_ROUNDS,
+    retry: int = 0,
+):
+    """Map draw ordinals to global sample ids — the weighted stream's
+    random-access primitive (every serve path composes this).
+
+    ``positions`` holds draw ordinals (callers wrap mod the epoch
+    length; the value stream depends only on the ordinal VALUE, never
+    on the array dtype, so uint32 and uint64 position lanes agree).
+    ``retry`` folds a dedup retry round into the key schedule — round 0
+    is the vectorised base draw, rounds >= 1 re-draw collisions
+    (sampling/dedup.py).  Bit-identical in numpy and jnp: pure integer
+    hash/mod/select lanes, like the mixture kernel.
+    """
+    sizes = tuple(int(n) for n in source_sizes)
+    S = len(sizes)
+    if len(table.probs) != S:
+        raise ValueError(
+            f"table has {len(table.probs)} columns for {S} sources")
+    offs, acc = [], 0
+    for n in sizes:
+        offs.append(acc)
+        acc += n
+    offs, total_n = tuple(offs), acc
+    big_ids = total_n > _I31
+    out_dtype = xp.int64 if big_ids else xp.int32
+    idx_dtype = xp.uint64 if big_ids else xp.uint32
+
+    ek = core.derive_epoch_key(xp, seed, epoch)
+    if int(retry):
+        ek = core.mix32(
+            xp, ek ^ core.mix32(xp, _u32c(xp, int(retry) ^ _C_RETRY)))
+
+    p = xp.asarray(positions)
+    if p.dtype == xp.uint64:
+        p_lo = (p & xp.asarray(np.uint64(0xFFFFFFFF))).astype(xp.uint32)
+        p_hi = (p >> xp.asarray(np.uint64(32))).astype(xp.uint32)
+    else:
+        p_lo = p.astype(xp.uint32)
+        p_hi = xp.zeros_like(p_lo)
+    base = core.mix32(
+        xp, ek ^ core.mix32(xp, p_lo ^ _u32c(xp, _C_POS))
+        ^ core.mix32(xp, p_hi ^ _u32c(xp, _C_POSH)))
+
+    # column draw + exact-integer accept test
+    j = core.mix32(xp, base ^ _u32c(xp, _C_SEL)) % _u32c(xp, S)
+    if table.total > _I31:
+        u = _draw64(xp, base, _C_ACC, _C_ACC2, table.total)
+        prob = _lane(xp, j, table.probs, xp.uint64)
+    else:
+        u = core.mix32(xp, base ^ _u32c(xp, _C_ACC)) \
+            % _u32c(xp, table.total)
+        prob = _lane(xp, j, table.probs, xp.uint32)
+    j = xp.where(u < prob, j, _lane(xp, j, table.alias, xp.uint32))
+
+    # within-source draw
+    max_n = max(sizes)
+    if max_n > _I31:
+        # the modulus is per-lane: draw a full 64-bit word, then mod
+        n_lane = _lane(xp, j, sizes, xp.uint64)
+        hi = core.mix32(xp, base ^ _u32c(xp, _C_LOC)).astype(xp.uint64)
+        lo = core.mix32(xp, base ^ _u32c(xp, _C_LOC2)).astype(xp.uint64)
+        local = ((hi << xp.asarray(np.uint64(32))) | lo) % n_lane
+    else:
+        n_lane = _lane(xp, j, sizes, xp.uint32)
+        local = core.mix32(xp, base ^ _u32c(xp, _C_LOC)) % n_lane
+
+    if shuffle:
+        W = int(window)
+        if W < 1:
+            raise ValueError(f"window must be >= 1, got {W}")
+        if any(n // W > 0xFFFFFFFF for n in sizes):
+            raise ValueError("source window count must fit in uint32")
+        # the within-window bijection, shared with the windowed
+        # permutation: full-window lanes route their offset through
+        # swap_or_not under the source-and-window key; tail lanes keep
+        # the hashed draw (already uniform on the tail)
+        body = _lane(xp, j, tuple((n // W) * W for n in sizes),
+                     local.dtype)
+        w_c = xp.asarray(np.asarray(W, dtype=local.dtype))
+        off = (local % w_c).astype(xp.uint32)
+        win = (local // w_c).astype(xp.uint32)
+        eks = core.mix32(xp, ek ^ core.mix32(xp, j ^ _u32c(xp, _C_SRC)))
+        kin = core.inner_key(xp, eks, win)
+        rho = core.swap_or_not(xp, off, W, kin, rounds,
+                               pair_key=core.inner_pair_key(xp, ek))
+        shuffled = win.astype(local.dtype) * w_c \
+            + rho.astype(local.dtype)
+        local = xp.where(local < body, shuffled, local)
+
+    out = _lane(xp, j, offs, idx_dtype) + local.astype(idx_dtype)
+    return out.astype(out_dtype)
+
+
+# --------------------------------------------------------- epoch streams
+def weighted_epoch_indices_generic(
+    xp, table, source_sizes, seed, epoch, rank, world, *,
+    epoch_samples, window, shuffle=True, drop_last=False,
+    partition="strided", rounds=core.DEFAULT_ROUNDS,
+):
+    """Rank's full weighted epoch stream: ``epoch_samples`` draw
+    ordinals partitioned by the shared rank-position law (wrap-padding
+    included), each mapped through the alias kernel."""
+    T = int(epoch_samples)
+    if T < 1:
+        raise ValueError(f"epoch_samples must be >= 1, got {T}")
+    num_samples, _ = core.shard_sizes(T, world, drop_last)
+    pos_dtype = xp.uint32 if T <= _I31 else xp.uint64
+    p = core.rank_positions(xp, T, rank, world, num_samples, partition,
+                            pos_dtype)
+    return weighted_stream_at_generic(
+        xp, p, table, source_sizes, seed, epoch,
+        window=window, shuffle=shuffle, rounds=rounds)
+
+
+def weighted_elastic_indices_generic(
+    xp, table, source_sizes, seed, epoch, rank, world, layers, *,
+    epoch_samples, window, shuffle=True, drop_last=False,
+    partition="strided", rounds=core.DEFAULT_ROUNDS,
+):
+    """Rank's weighted remainder stream after a §6 elastic cascade —
+    the shared remainder law composed with the alias kernel (ordinals
+    wrap mod the epoch length exactly like plain-mode positions)."""
+    T = int(epoch_samples)
+    chain, remaining, num_samples = core.elastic_chain(
+        T, layers, world, drop_last)
+    total_n = sum(int(n) for n in source_sizes)
+    out_dtype = np.int32 if total_n <= _I31 else np.int64
+    if remaining == 0 or num_samples == 0:
+        return xp.asarray(np.empty(0, dtype=out_dtype))
+    pos_dtype = xp.uint32 if T <= _I31 else xp.uint64
+    q = core.rank_positions(xp, remaining, rank, world, num_samples,
+                            partition, pos_dtype)
+    pos = core.compose_remainder_chain(xp, q, chain, partition, pos_dtype)
+    pos = pos % xp.asarray(T, dtype=pos_dtype)
+    return weighted_stream_at_generic(
+        xp, pos, table, source_sizes, seed, epoch,
+        window=window, shuffle=shuffle, rounds=rounds)
+
+
+# ------------------------------------------------------------- frontends
+def weighted_epoch_indices_np(table, source_sizes, seed, epoch, rank,
+                              world, **kw):
+    """numpy reference frontend (the normative CPU twin)."""
+    return weighted_epoch_indices_generic(
+        np, table, source_sizes, seed, epoch, rank, world, **kw)
+
+
+def weighted_elastic_indices_np(table, source_sizes, seed, epoch, rank,
+                                world, layers, **kw):
+    return weighted_elastic_indices_generic(
+        np, table, source_sizes, seed, epoch, rank, world, layers, **kw)
+
+
+def _require_x64_for_big_sampling(table: AliasTable, source_sizes,
+                                  epoch_samples: int) -> None:
+    """Weighted draws whose id space, mass total, or ordinal space
+    reaches 2^31 need uint64 lanes; without x64 jnp silently demotes —
+    refuse loudly (the mixture guard's sampling counterpart)."""
+    import jax
+
+    total_n = sum(int(n) for n in source_sizes)
+    if (total_n > _I31 or table.total > _I31
+            or int(epoch_samples) > _I31
+            or max(int(n) for n in source_sizes) > _I31) \
+            and not jax.config.read("jax_enable_x64"):
+        raise ValueError(
+            "weighted sampling over >= 2^31 ids/mass/ordinals needs "
+            "64-bit math: enable x64 (enable_big_index_space())")
+
+
+def weighted_epoch_indices_jax(table, source_sizes, seed, epoch, rank,
+                               world, **kw):
+    """Jitted device frontend — one compiled program per
+    ``(table, sizes, world, flags)``; ``epoch``/``rank`` traced."""
+    import jax
+
+    _require_x64_for_big_sampling(table, source_sizes,
+                                  kw.get("epoch_samples", 1))
+    fn = _compiled_weighted(
+        table.probs, table.alias, int(table.total), table.masses,
+        tuple(int(n) for n in source_sizes), int(world),
+        int(kw.pop("epoch_samples")), int(kw.pop("window")),
+        kw.pop("shuffle", True), kw.pop("drop_last", False),
+        kw.pop("partition", "strided"),
+        kw.pop("rounds", core.DEFAULT_ROUNDS))
+    if kw:
+        raise TypeError(f"unexpected kwargs: {sorted(kw)}")
+    if not isinstance(seed, (int, np.integer)):
+        raise TypeError(
+            "this frontend takes concrete int seeds (one executable is "
+            "cached per seed) — for a traced seed call "
+            "weighted_epoch_indices_generic with a folded (lo, hi) pair")
+    return fn(int(seed),
+              core.as_u32_scalar(jax.numpy, epoch),
+              core.as_u32_scalar(jax.numpy, rank))
+
+
+@functools.lru_cache(maxsize=64)
+def _compiled_weighted(probs, alias, total, masses, sizes, world,
+                       epoch_samples, window, shuffle, drop_last,
+                       partition, rounds):
+    import jax
+    import jax.numpy as jnp
+
+    table = AliasTable(probs=probs, alias=alias, total=total,
+                       masses=masses)
+
+    @functools.lru_cache(maxsize=8)
+    def for_seed(seed: int):
+        @jax.jit
+        def fn(epoch, rank):
+            return weighted_epoch_indices_generic(
+                jnp, table, sizes, seed, epoch, rank, world,
+                epoch_samples=epoch_samples, window=window,
+                shuffle=shuffle, drop_last=drop_last,
+                partition=partition, rounds=rounds)
+
+        return fn
+
+    return lambda seed, epoch, rank: for_seed(seed)(epoch, rank)
+
+
+def weighted_elastic_indices_jax(table, source_sizes, seed, epoch, rank,
+                                 world, layers, **kw):
+    """Device elastic frontend; the cascade shapes are static, so each
+    distinct ``layers`` compiles its own program (reshards are rare)."""
+    import jax.numpy as jnp
+
+    _require_x64_for_big_sampling(table, source_sizes,
+                                  kw.get("epoch_samples", 1))
+    out = weighted_elastic_indices_generic(
+        jnp, table, source_sizes, seed, epoch, rank, world,
+        [(int(w), int(c)) for w, c in layers], **kw)
+    return np.asarray(out)

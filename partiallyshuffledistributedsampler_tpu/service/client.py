@@ -1241,8 +1241,16 @@ class ServiceIndexClient:
         return np.concatenate(parts)
 
     # ----------------------------------------------------------- control ops
-    def set_epoch(self, epoch: int) -> int:
-        _, header, _ = self._rpc(P.MSG_SET_EPOCH, {"epoch": int(epoch)})
+    def set_epoch(self, epoch: int, *, weights_delta=None) -> int:
+        """Move the server to ``epoch``.  ``weights_delta`` (prioritized
+        sampling specs only, docs/SAMPLING.md) is an additive per-source
+        re-weight folded into the weights effective at the new epoch —
+        the streaming ``weights_delta`` law applied at an epoch
+        boundary.  Zero protocol bytes when omitted."""
+        body = {"epoch": int(epoch)}
+        if weights_delta is not None:
+            body["weights_delta"] = [int(x) for x in weights_delta]
+        _, header, _ = self._rpc(P.MSG_SET_EPOCH, body)
         self.server_epoch = int(header["epoch"])
         return self.server_epoch
 

@@ -280,6 +280,11 @@ class IndexServer(DispatchListener):
         #: current horizon generation, and GET_BATCH/GET_CAPABILITY run
         #: the eligibility + ack-gated advance gate before serving
         self.streaming = getattr(spec, "mode", None) == "stream"
+        #: True for a non-uniform sampling spec (docs/SAMPLING.md):
+        #: SET_EPOCH accepts additive ``weights_delta`` re-weights
+        #: (prioritized mode) and snapshots carry the adopted weights
+        #: plus the dedup seen-state boundary
+        self.sampling = getattr(spec, "sampling_mode", None) is not None
         #: absolute appended-sample total — monotonic, so a WAL replay
         #: takes the max and a dropped append record can only UNDER-count
         #: (the eligibility gate then serves later, never twice)
@@ -696,6 +701,24 @@ class IndexServer(DispatchListener):
                 "weights": {str(g): [int(x) for x in w]
                             for g, w in self.spec.stream_weights.items()},
             }
+        if self.sampling:
+            # additive within format 2 (docs/SAMPLING.md): adopted
+            # per-epoch weights (prioritized) and the newest dedup
+            # epoch-boundary seen state.  Both are recomputable — the
+            # weights from the WAL, the boundary by refolding from
+            # epoch 0 — so the block is a recovery accelerator, never
+            # the source of truth
+            blk = {"weights": {str(g): [int(x) for x in w]
+                               for g, w in self.spec.stream_weights.items()}}
+            bw = None
+            if hasattr(self.spec, "dedup_boundary_wire"):
+                # epoch + 1: serving epoch e folds through its END, so
+                # the newest boundary the spec holds is e+1's start —
+                # exactly the state a successor needs for epoch e+1
+                bw = self.spec.dedup_boundary_wire(self.epoch + 1)
+            if bw is not None:
+                blk["dedup"] = bw
+            state["sampling"] = blk
         if self._wal is not None and self._repl_log is not None:
             # the WAL position this snapshot reflects — recovery
             # replays the tail strictly above it.  Exact: every append
@@ -858,6 +881,20 @@ class IndexServer(DispatchListener):
                     self.spec = self.spec.with_stream_weights(
                         {int(g): tuple(int(x) for x in ws)
                          for g, ws in w.items()})
+            sm = state.get("sampling")
+            if self.sampling and sm is not None:
+                w = sm.get("weights") or {}
+                if w:
+                    self.spec = self.spec.with_stream_weights(
+                        {int(g): tuple(int(x) for x in ws)
+                         for g, ws in w.items()})
+                bw = sm.get("dedup")
+                if bw is not None and hasattr(self.spec,
+                                              "with_dedup_boundary"):
+                    # recovery accelerator only: folding from epoch 0
+                    # reaches the identical state (docs/SAMPLING.md)
+                    self.spec = self.spec.with_dedup_boundary(
+                        int(bw["epoch"]), bw["seen"])
             rs = state.get("reshard")
             if rs is not None:
                 self._reshard = {
@@ -1111,6 +1148,17 @@ class IndexServer(DispatchListener):
                 self.spec = self.spec.with_stream_weights(
                     {int(g): tuple(int(x) for x in ws)
                      for g, ws in w.items()})
+        sm = state.get("sampling")
+        if self.sampling and sm is not None:
+            w = sm.get("weights") or {}
+            if w:
+                self.spec = self.spec.with_stream_weights(
+                    {int(g): tuple(int(x) for x in ws)
+                     for g, ws in w.items()})
+            bw = sm.get("dedup")
+            if bw is not None and hasattr(self.spec, "with_dedup_boundary"):
+                self.spec = self.spec.with_dedup_boundary(
+                    int(bw["epoch"]), bw["seen"])
         rs = state.get("reshard")
         if rs is not None:
             self._reshard = {
@@ -1201,6 +1249,16 @@ class IndexServer(DispatchListener):
                         {int(ep): tuple(int(x) for x in w)})
                 self.epoch = max(self.epoch, int(ep))
                 self._stream_pending = None
+        elif op == "sampling":
+            # a prioritized re-weight adopted at SET_EPOCH
+            # (docs/SAMPLING.md): the folded EFFECTIVE weights ride the
+            # record, so replay adopts the same alias table without
+            # re-deriving the fold — idempotent under re-application
+            w = rec.get("weights")
+            if w is not None and self.sampling:
+                self.spec = self.spec.with_stream_weights(
+                    {int(rec["epoch"]): tuple(int(x) for x in w)})
+            self.epoch = int(rec["epoch"])
         elif op == "autopilot":
             # a controller decision (autopilot/controller.py): keep the
             # NEWEST policy state only — a promoted standby seeds its
@@ -1589,11 +1647,52 @@ class IndexServer(DispatchListener):
             })
 
     def _on_set_epoch(self, sock, header) -> None:
+        delta = header.get("weights_delta")
+        folded = None
         with self._lock:
+            if delta is not None:
+                # prioritized re-weighting (docs/SAMPLING.md): the
+                # additive delta folds into the weights EFFECTIVE at the
+                # new epoch — the streaming advance's fold law applied
+                # at an epoch boundary.  Weights stay >= 1 so no source
+                # is silently starved to zero by a large negative delta.
+                if (not self.sampling
+                        or getattr(self.spec, "sampling_mode", None)
+                        != "prioritized"):
+                    P.send_msg(sock, P.MSG_ERROR, {
+                        "code": "bad_request",
+                        "detail": "weights_delta requires a prioritized "
+                                  "sampling spec",
+                    })
+                    return
+                new_epoch = int(header.get("epoch", 0))
+                base = self.spec.effective_weights(new_epoch)
+                if len(delta) != len(base):
+                    P.send_msg(sock, P.MSG_ERROR, {
+                        "code": "bad_request",
+                        "detail": f"weights_delta has {len(delta)} "
+                                  f"entries for {len(base)} sources",
+                    })
+                    return
+                from ..streaming.spec import WEIGHTS_RETAIN
+
+                folded = tuple(max(1, int(a) + int(b))
+                               for a, b in zip(base, delta))
+                self.spec = self.spec.with_stream_weights(
+                    {new_epoch: folded},
+                    prune_below=new_epoch - WEIGHTS_RETAIN // 2)
+                self.metrics.inc("sampling_reweights")
             self.epoch = int(header.get("epoch", 0))
-            self._repl_append("epoch", epoch=self.epoch)
+            if folded is not None:
+                self._repl_append("sampling", epoch=self.epoch,
+                                  weights=[int(x) for x in folded])
+            else:
+                self._repl_append("epoch", epoch=self.epoch)
         self._write_snapshot(force=True)
-        P.send_msg(sock, P.MSG_OK, {"epoch": self.epoch})
+        reply = {"epoch": self.epoch}
+        if folded is not None:
+            reply["weights"] = [int(x) for x in folded]
+        P.send_msg(sock, P.MSG_OK, reply)
 
     def _ack_advance_locked(self, rank: int, lease: dict, epoch, ack) -> bool:
         """Advance ``rank``'s delivered-ack cursor for ``epoch`` and, if
@@ -1714,13 +1813,14 @@ class IndexServer(DispatchListener):
         the canonical encoding (docs/CAPABILITY.md).  Under
         ``self._lock``."""
         extra = {}
-        if self.streaming:
-            # the horizon's effective mixture weights ride the grant
-            # (docs/STREAMING.md): regen on the client substitutes them
-            # before evaluating, so a re-weighted horizon folds
-            # bit-identically on device.  Absent for plain-base streams
-            # and for every frozen-dataset grant (old grants verify
-            # unchanged).
+        if self.streaming or self.sampling:
+            # the effective weights ride the grant — horizon mixture
+            # weights (docs/STREAMING.md) or adopted prioritized
+            # sampling weights (docs/SAMPLING.md): regen on the client
+            # substitutes them before evaluating, so a re-weighted
+            # stream folds bit-identically on device.  Absent for
+            # plain-base streams, for static sampling specs, and for
+            # every frozen-dataset grant (old grants verify unchanged).
             w = self.spec.weights_for(int(epoch))
             if w is not None:
                 extra["stream_weights"] = tuple(int(x) for x in w)

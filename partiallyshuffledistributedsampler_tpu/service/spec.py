@@ -322,6 +322,12 @@ class PartialShuffleSpec:
             from ..streaming.spec import StreamSpec
 
             return StreamSpec.from_wire(d, backend=backend)
+        if (d.get("mode") in ("weighted", "prioritized", "dedup")
+                and cls is PartialShuffleSpec):
+            # non-uniform sampling modes (docs/SAMPLING.md) likewise
+            from ..sampling.spec import SamplingSpec
+
+            return SamplingSpec.from_wire(d, backend=backend)
         d = dict(d)
         kwargs = d.pop("kwargs", {})
         mk = d.pop("mixture_key", None)

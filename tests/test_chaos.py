@@ -866,3 +866,65 @@ def test_wal_append_disk_full_recovery_stays_dense(tmp_path):
     finally:
         srv2.stop()
     assert srv2.metrics.report()["counters"].get("wal_recoveries", 0) == 1
+
+
+# -------------------------------------------------- sampling fault matrix
+def _weighted_spec(weights):
+    from partiallyshuffledistributedsampler_tpu.sampling import SamplingSpec
+    return SamplingSpec.weighted((40, 30, 26), weights, epoch_samples=96,
+                                 seed=7, window=8)
+
+
+def test_sampling_alias_build_fault_serves_uniform_loudly():
+    """An injected alias-table build failure degrades to the UNIFORM
+    table — loudly (RuntimeWarning), deterministically (the served
+    stream equals the uniform-weights stream bit-for-bit), and on every
+    surface (the fallback is computed inside the spec, so served
+    batches and local regen degrade identically)."""
+    spec = _weighted_spec((3, 1, 2))
+    ref = _weighted_spec((1, 1, 1)).rank_indices(1, 0)
+    plan = F.FaultPlan([F.FaultRule(site="sampling.alias_build",
+                                    kind="error", count=0)])
+    # same-thread check first: the fallback warns where it degrades
+    with plan:
+        with pytest.warns(RuntimeWarning, match="UNIFORM"):
+            direct = spec.rank_indices(1, 0)
+    assert np.array_equal(direct, ref)
+    # then the served path: the server-side fallback serves the same
+    # degraded-but-deterministic stream
+    plan2 = F.FaultPlan([F.FaultRule(site="sampling.alias_build",
+                                     kind="error", count=0)])
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        with plan2:
+            with IndexServer(_weighted_spec((3, 1, 2))) as srv:
+                with ServiceIndexClient(srv.address, rank=0, batch=37,
+                                        backoff_base=0.01,
+                                        reconnect_timeout=10.0) as client:
+                    got = client.epoch_indices(1)
+    assert plan.fired("sampling.alias_build") > 0, "vacuous"
+    assert plan2.fired("sampling.alias_build") > 0, "vacuous"
+    assert np.array_equal(got, ref), "served fallback diverged from uniform"
+
+
+def test_sampling_dedup_check_fault_never_double_serves():
+    """An injected seen-set membership failure is fail-safe: the check
+    reports 'seen', the draw probes on, and the cross-epoch no-repeat
+    law survives — a dedup fault may skip candidates, never re-serve
+    one."""
+    from partiallyshuffledistributedsampler_tpu.sampling import SamplingSpec
+    spec = SamplingSpec.deduped((40, 30, 26), epoch_samples=48, seed=7,
+                                window=8)
+    plan = F.FaultPlan([F.FaultRule(site="sampling.dedup_check",
+                                    kind="error", nth=5, count=3)])
+    with plan:
+        with IndexServer(spec) as srv:
+            with ServiceIndexClient(srv.address, rank=0, batch=16,
+                                    backoff_base=0.01,
+                                    reconnect_timeout=10.0) as client:
+                e0 = client.epoch_indices(0)
+                e1 = client.epoch_indices(1)
+    assert plan.fired("sampling.dedup_check") >= 1, "vacuous"
+    assert len(e0) == 48 and len(e1) == 48, "epoch length moved"
+    union = np.concatenate([e0, e1]).tolist()
+    assert len(set(union)) == len(union), "dedup fault double-served an id"

@@ -32,6 +32,7 @@ _NAMESPACES = (
     "partiallyshuffledistributedsampler_tpu.fleetsim",
     "partiallyshuffledistributedsampler_tpu.capability",
     "partiallyshuffledistributedsampler_tpu.streaming",
+    "partiallyshuffledistributedsampler_tpu.sampling",
     "partiallyshuffledistributedsampler_tpu.telemetry",
     "partiallyshuffledistributedsampler_tpu.utils",
 )
@@ -468,4 +469,51 @@ def test_simulator_doc_cross_linked():
 
     res = (DOCS / "RESILIENCE.md").read_text()
     for site in ("sim.event", "sim.inject"):
+        assert site in F.SITES and site in res
+
+
+def test_sampling_doc_cross_linked():
+    """The sampling modes are documented where an operator would look:
+    docs/SAMPLING.md owns the alias/weight-update/dedup-lifecycle story
+    (and the make gate), SERVICE.md carries the SET_EPOCH weights_delta
+    field and a section pointing at it, API.md documents the spec and
+    kernel surface, OBSERVABILITY.md the counter plus the degradation
+    events, CAPABILITY.md the weights-carrying grants, and
+    RESILIENCE.md the fault sites."""
+    sampling_md = DOCS / "SAMPLING.md"
+    assert sampling_md.exists()
+    text = sampling_md.read_text()
+    for token in ("SamplingSpec", "weighted", "prioritized", "dedup",
+                  "alias", "weights_delta", "with_stream_weights",
+                  "stream_weights", "seen-set", "dedup_boundary_wire",
+                  "with_dedup_boundary", "UNIFORM",
+                  "sampling.alias_build", "sampling.dedup_check",
+                  "sampling_reweights", "sampling-smoke"):
+        assert token in text, f"docs/SAMPLING.md lost `{token}`"
+    for doc in ("SERVICE.md", "RESILIENCE.md", "CAPABILITY.md",
+                "STREAMING.md", "API.md"):
+        assert "SAMPLING.md" in (DOCS / doc).read_text(), (
+            f"docs/{doc} lost its cross-link to docs/SAMPLING.md")
+    assert "docs/SAMPLING.md" in (DOCS.parent / "README.md").read_text()
+    svc = (DOCS / "SERVICE.md").read_text()
+    assert "## Sampling modes" in svc, (
+        "docs/SERVICE.md lost its Sampling modes section")
+    assert "weights_delta" in svc, (
+        "docs/SERVICE.md lost the SET_EPOCH `weights_delta` field")
+    api = API_MD.read_text()
+    for token in ("SamplingSpec", "build_alias_table",
+                  "weighted_epoch_indices_np", "weighted_epoch_indices_jax",
+                  "make_seen", "fold_epoch", "dedup_check",
+                  "weights_delta", "dedup_boundary_wire"):
+        assert token in api, f"docs/API.md lost the sampling surface `{token}`"
+    obs = OBSERVABILITY_MD.read_text()
+    for token in ("sampling_reweights", "sampling_alias_fallback",
+                  "sampling_dedup_failsafe", "sampling_dedup_saturated"):
+        assert token in obs, (
+            f"docs/OBSERVABILITY.md lost the sampling token `{token}`")
+    # the documented fault sites must be the registered ones
+    from partiallyshuffledistributedsampler_tpu import faults as F
+
+    res = (DOCS / "RESILIENCE.md").read_text()
+    for site in ("sampling.alias_build", "sampling.dedup_check"):
         assert site in F.SITES and site in res

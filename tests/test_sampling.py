@@ -1,0 +1,608 @@
+"""Non-uniform sampling modes (docs/SAMPLING.md).
+
+The contract under test: the ``weighted`` / ``prioritized`` / ``dedup``
+sampling modes are ordinary specs — bit-identical across the CPU twin
+and the jitted device kernel, across served batches, capability local
+regen and degraded local regen, and across a mid-epoch reshard plus a
+primary-kill failover — while obeying their own laws: empirical draw
+frequencies track the weights, additive ``weights_delta`` re-weights
+fold at epoch boundaries with zero protocol bytes when static, and the
+dedup seen-set never re-serves across epochs nor loses samples across
+recovery.
+
+These run inside tier-1 and are the first leg of the
+``make sampling-smoke`` gate (``-m sampling``).
+"""
+
+from __future__ import annotations
+
+import threading
+import warnings
+from collections import Counter
+
+import numpy as np
+import pytest
+
+from partiallyshuffledistributedsampler_tpu import telemetry
+from partiallyshuffledistributedsampler_tpu.sampling import (
+    BloomSeen,
+    SamplingSpec,
+    build_alias_table,
+)
+from partiallyshuffledistributedsampler_tpu.service import (
+    IndexServer,
+    ServiceError,
+    ServiceIndexClient,
+)
+from partiallyshuffledistributedsampler_tpu.service.spec import (
+    PartialShuffleSpec,
+)
+
+from test_failover import replicated_pair, wait_for, wait_synced
+
+pytestmark = pytest.mark.sampling
+
+SECRET = b"psds-test-deployment-secret"
+
+SIZES = (40, 30, 26)   #: three sources over a 96-id space
+T = 96                 #: epoch draw budget (divisible by worlds 2, 3, 4)
+#: dedup epochs draw HALF the id space, so epochs 0+1 tile it exactly
+#: once (the strongest no-repeat law) and epoch 2 must saturate
+T_DEDUP = 48
+
+
+def build_spec(mode, world=1, **kw):
+    kw.setdefault("seed", 7)
+    kw.setdefault("window", 8)
+    if mode == "weighted":
+        return SamplingSpec.weighted(SIZES, (3, 1, 2), epoch_samples=T,
+                                     world=world, **kw)
+    if mode == "prioritized":
+        return SamplingSpec.prioritized(SIZES, (1, 1, 1), epoch_samples=T,
+                                        world=world, **kw)
+    return SamplingSpec.deduped(SIZES, epoch_samples=T_DEDUP, world=world,
+                                **kw)
+
+
+def source_of(x):
+    if x < SIZES[0]:
+        return 0
+    return 1 if x < SIZES[0] + SIZES[1] else 2
+
+
+# ------------------------------------------------------------ alias laws
+def test_alias_table_exact_structure():
+    t = build_alias_table((3, 1, 0, 2), "per_source", (100, 50, 25, 7))
+    assert t.total == 6
+    assert sum(t.probs) + sum(t.total - p for p in t.probs) == 4 * t.total
+    # columns sum exactly: every source's mass is fully represented
+    mass = [0] * 4
+    for s in range(4):
+        mass[s] += t.probs[s]
+        mass[t.alias[s]] += t.total - t.probs[s]
+    assert mass == [m * 4 for m in (3, 1, 0, 2)]
+
+
+def test_alias_degenerate_uniform_and_one_hot_exact():
+    # uniform weights: every column is a full column of itself
+    t = build_alias_table((5, 5, 5), "per_source", SIZES)
+    assert all(p == t.total for p in t.probs)
+    assert tuple(t.alias) == (0, 1, 2)
+    # one-hot: every draw must land inside the hot source, exactly
+    spec = SamplingSpec.weighted(SIZES, (0, 1, 0), epoch_samples=T, seed=3)
+    got = spec.rank_indices(0, 0)
+    lo, hi = SIZES[0], SIZES[0] + SIZES[1]
+    assert len(got) == T
+    assert all(lo <= int(x) < hi for x in got)
+
+
+def test_alias_scaling_invariance():
+    a = build_alias_table((3, 1, 2), "per_source", SIZES)
+    b = build_alias_table((21, 7, 14), "per_source", SIZES)
+    # GCD canonicalization: proportional weights build the SAME table
+    assert a == b and a.total == 6
+    # and the streams are identical: only the RATIOS are the identity
+    s1 = SamplingSpec.weighted(SIZES, (3, 1, 2), epoch_samples=T, seed=7)
+    s2 = SamplingSpec.weighted(SIZES, (21, 7, 14), epoch_samples=T, seed=7)
+    assert np.array_equal(s1.rank_indices(0, 0), s2.rank_indices(0, 0))
+
+
+def test_statistical_law_frequencies_track_weights():
+    """Empirical per-source frequencies of a seeded run stay within a
+    fixed tolerance of the target ratios — per_source AND per_sample."""
+    big = SamplingSpec.weighted(SIZES, (5, 0, 3), epoch_samples=40_000,
+                                seed=11)
+    got = big.rank_indices(0, 0)
+    counts = Counter(source_of(int(x)) for x in got)
+    assert counts[1] == 0
+    for s, target in ((0, 5 / 8), (2, 3 / 8)):
+        f = counts[s] / 40_000
+        assert abs(f - target) < 0.02, (s, f, target)
+    # per_sample: mass is weight * size -> (40*2, 30*0, 26*5)
+    ps = SamplingSpec.weighted(SIZES, (2, 0, 5), epoch_samples=40_000,
+                               weight_kind="per_sample", seed=11)
+    got = ps.rank_indices(0, 0)
+    counts = Counter(source_of(int(x)) for x in got)
+    tot = 40 * 2 + 26 * 5
+    assert counts[1] == 0
+    for s, target in ((0, 80 / tot), (2, 130 / tot)):
+        f = counts[s] / 40_000
+        assert abs(f - target) < 0.02, (s, f, target)
+
+
+# ----------------------------------------------------- CPU/device identity
+@pytest.mark.parametrize("mode", ["weighted", "prioritized", "dedup"])
+def test_cpu_vs_device_bit_identity(mode):
+    """The jitted device kernel and the CPU twin agree bit-for-bit —
+    epoch streams AND elastic cascade layers (for dedup the fold itself
+    is host-normative, so backend choice must be a no-op)."""
+    cpu = build_spec(mode, world=2)
+    dev = PartialShuffleSpec.from_wire(cpu.to_wire(), backend="xla")
+    if mode == "prioritized":
+        cpu = cpu.with_stream_weights({1: (4, 1, 2)})
+        dev = dev.with_stream_weights({1: (4, 1, 2)})
+    # consumed must fit the per-rank share (T/2 per rank at world 2)
+    layers = [(2, 18)] if mode == "dedup" else [(2, 36)]
+    for epoch in (0, 1):
+        for r in range(2):
+            a = np.asarray(cpu.rank_indices(epoch, r))
+            b = np.asarray(dev.rank_indices(epoch, r))
+            assert np.array_equal(a, b), (mode, epoch, r)
+            if mode != "dedup" or epoch == 0:
+                c = np.asarray(cpu.rank_indices(epoch, r, layers=layers))
+                d = np.asarray(dev.rank_indices(epoch, r, layers=layers))
+                assert np.array_equal(c, d), (mode, "elastic", epoch, r)
+
+
+@pytest.mark.parametrize("mode", ["weighted", "prioritized", "dedup"])
+def test_wire_roundtrip_and_world_stripped_fingerprint(mode):
+    spec = build_spec(mode, world=2)
+    rt = PartialShuffleSpec.from_wire(spec.to_wire())
+    assert isinstance(rt, SamplingSpec)
+    assert rt.fingerprint() == spec.fingerprint()
+    assert np.array_equal(rt.rank_indices(0, 0), spec.rank_indices(0, 0))
+    w3 = spec.with_world(3)
+    assert w3.fingerprint() != spec.fingerprint()
+    assert (w3.fingerprint(include_world=False)
+            == spec.fingerprint(include_world=False))
+    if mode == "prioritized":
+        # adopted weights stay OUT of the wire: same stream identity
+        re = spec.with_stream_weights({2: (9, 1, 1)})
+        assert re.fingerprint() == spec.fingerprint()
+        assert not np.array_equal(re.rank_indices(2, 0),
+                                  spec.rank_indices(2, 0))
+        assert np.array_equal(re.rank_indices(1, 0),
+                              spec.rank_indices(1, 0))
+
+
+def test_union_of_ranks_is_the_global_stream():
+    for mode in ("weighted", "dedup"):
+        g = build_spec(mode, world=1)
+        w4 = g.with_world(4)
+        u = np.concatenate([w4.rank_indices(1, r) for r in range(4)])
+        assert sorted(u.tolist()) == sorted(g.rank_indices(1, 0).tolist())
+
+
+# -------------------------------------------------------------- dedup laws
+def test_dedup_never_repeats_across_epochs():
+    """Epochs 0+1 (2 x 48 draws over 96 ids) tile the id space exactly
+    once — the seen-set turns sampling-with-replacement into full
+    coverage; epoch 2 must then saturate, loudly, at full length."""
+    spec = build_spec("dedup")
+    served = []
+    for e in range(2):
+        got = spec.rank_indices(e, 0)
+        assert len(got) == T_DEDUP
+        served.extend(int(x) for x in got)
+    assert sorted(served) == list(range(sum(SIZES)))
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        e2 = spec.rank_indices(2, 0)
+    assert len(e2) == T_DEDUP
+    assert any("saturated" in str(x.message) for x in w)
+
+
+def test_dedup_saturation_is_loud_and_keeps_epoch_length():
+    tiny = SamplingSpec.deduped((4, 4), epoch_samples=6, seed=3, window=4)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        a = tiny.rank_indices(0, 0)
+        b = tiny.rank_indices(1, 0)
+    assert len(a) == 6 and len(b) == 6
+    assert len(set(a.tolist() + b.tolist())) == 8, "ids lost pre-saturation"
+    assert any("saturated" in str(x.message) for x in w)
+
+
+def test_dedup_boundary_snapshot_equals_refold():
+    spec = build_spec("dedup")
+    spec.rank_indices(1, 0)  # folds epochs 0..1, caching boundaries
+    bw = spec.dedup_boundary_wire(1)
+    assert bw is not None and bw["epoch"] == 1
+    fresh = build_spec("dedup").with_dedup_boundary(bw["epoch"], bw["seen"])
+    assert np.array_equal(fresh.rank_indices(1, 0), spec.rank_indices(1, 0))
+
+
+def test_bloom_no_false_negatives_and_filtering():
+    bs = BloomSeen(1 << 12, 4, seed=42)
+    for x in range(500):
+        bs.add(x * 3)
+    assert all(bs.contains(x * 3) for x in range(500))
+    spec = SamplingSpec.deduped(
+        SIZES, epoch_samples=T_DEDUP, seed=7, window=8,
+        dedup={"kind": "bloom", "bits": 4096, "hashes": 3})
+    served = [int(x) for e in range(2) for x in spec.rank_indices(e, 0)]
+    assert len(set(served)) == len(served), "bloom mode re-served an id"
+    bw = spec.dedup_boundary_wire(1)
+    fresh = SamplingSpec.deduped(
+        SIZES, epoch_samples=T_DEDUP, seed=7, window=8,
+        dedup={"kind": "bloom", "bits": 4096, "hashes": 3})
+    fresh = fresh.with_dedup_boundary(bw["epoch"], bw["seen"])
+    assert np.array_equal(fresh.rank_indices(1, 0), spec.rank_indices(1, 0))
+
+
+# ------------------------------------------------------- three serve paths
+@pytest.mark.parametrize("mode", ["weighted", "prioritized", "dedup"])
+def test_three_serve_paths_bit_identical(mode):
+    """Served batches, capability local regen, and degraded local regen
+    produce the identical stream for every mode."""
+    spec = build_spec(mode, world=2)
+    # one FRESH server per arm: delivery is exactly-once per rank, so
+    # re-serving the same epoch to the same rank on one server would
+    # (correctly) come back empty on the second arm
+    for arm in ("served", "capability", "degraded"):
+        with IndexServer(build_spec(mode, world=2),
+                         capability_secret=SECRET) as srv:
+            for r in range(2):
+                local = np.asarray(spec.rank_indices(0, r))
+                c = ServiceIndexClient(srv.address, rank=r, batch=16,
+                                       spec=build_spec(mode, world=2),
+                                       capability_secret=SECRET,
+                                       backoff_base=0.01,
+                                       reconnect_timeout=10.0)
+                try:
+                    if arm == "served":
+                        arr = np.concatenate(list(c.epoch_batches(0)))
+                    elif arm == "capability":
+                        arr = np.asarray(c.capability_epoch_indices(
+                            0, spec=build_spec(mode, world=2)))
+                    else:
+                        arr = np.asarray(c.local_epoch_indices(
+                            build_spec(mode, world=2), 0))
+                finally:
+                    c.close()
+                assert np.array_equal(arr, local), (mode, r, arm)
+
+
+def test_prioritized_weights_delta_folds_at_epoch_boundary():
+    """SET_EPOCH's additive ``weights_delta`` re-weights the alias table
+    with the streaming fold law; the signed capability carries the
+    effective weights so the regen arm tracks; a static spec keeps the
+    grant byte-identical (``weights_for`` stays None)."""
+    spec = build_spec("prioritized", world=1)
+    with IndexServer(spec, capability_secret=SECRET) as srv:
+        c = ServiceIndexClient(srv.address, rank=0, batch=16,
+                               spec=build_spec("prioritized", world=1),
+                               capability_secret=SECRET,
+                               backoff_base=0.01, reconnect_timeout=10.0)
+        try:
+            base_e0 = np.concatenate(list(c.epoch_batches(0)))
+            assert srv.spec.weights_for(0) is None, "static spec adopted"
+            c.set_epoch(1, weights_delta=[4, 0, 0])
+            assert srv.spec.weights_for(1) == (5, 1, 1)
+            assert srv.spec.weights_for(0) is None
+            served = np.concatenate(list(c.epoch_batches(1)))
+        finally:
+            c.close()
+        assert srv.metrics.report()["counters"]["sampling_reweights"] >= 1
+    # the capability arm on its own server (delivery is exactly-once
+    # per rank): the grant's effective weights drive local regen
+    with IndexServer(build_spec("prioritized", world=1),
+                     capability_secret=SECRET) as srv:
+        c2 = ServiceIndexClient(srv.address, rank=None, batch=16,
+                                attach=True, backoff_base=0.01,
+                                reconnect_timeout=10.0)
+        try:
+            c2.set_epoch(1, weights_delta=[4, 0, 0])
+        finally:
+            c2.close()
+        c3 = ServiceIndexClient(srv.address, rank=0, batch=16,
+                                spec=build_spec("prioritized", world=1),
+                                capability_secret=SECRET,
+                                backoff_base=0.01, reconnect_timeout=10.0)
+        try:
+            cap = np.asarray(c3.capability_epoch_indices(
+                1, spec=build_spec("prioritized", world=1)))
+        finally:
+            c3.close()
+    assert len(base_e0) == T
+    ref = build_spec("prioritized", world=1).with_stream_weights(
+        {1: (5, 1, 1)})
+    assert np.array_equal(served, ref.rank_indices(1, 0))
+    assert np.array_equal(cap, served), "capability arm diverged"
+    assert not np.array_equal(  # the re-weight genuinely moved epoch 1
+        served, build_spec("prioritized", world=1).rank_indices(1, 0))
+
+
+def test_weights_delta_refused_for_non_prioritized():
+    with IndexServer(build_spec("weighted", world=1)) as srv:
+        c = ServiceIndexClient(srv.address, rank=0, batch=16,
+                               backoff_base=0.01, reconnect_timeout=10.0)
+        try:
+            with pytest.raises(ServiceError):
+                c.set_epoch(1, weights_delta=[1, 0, 0])
+        finally:
+            c.close()
+        assert srv.epoch == 0, "refused delta must not move the epoch"
+    with IndexServer(build_spec("prioritized", world=1)) as srv:
+        c = ServiceIndexClient(srv.address, rank=0, batch=16,
+                               backoff_base=0.01, reconnect_timeout=10.0)
+        try:
+            with pytest.raises(ServiceError):  # wrong arity refused too
+                c.set_epoch(1, weights_delta=[1])
+        finally:
+            c.close()
+        assert srv.epoch == 0 and srv.spec.weights_for(1) is None
+
+
+def test_prioritized_reweight_survives_failover():
+    """The sampling WAL record replicates an adopted re-weight: the
+    promoted standby serves the re-weighted epoch bit-identically."""
+    spec = build_spec("prioritized", world=1)
+    primary, standby = replicated_pair(spec)
+    try:
+        c = ServiceIndexClient(primary.address, rank=0, batch=16,
+                               spec=build_spec("prioritized", world=1),
+                               backoff_base=0.01, reconnect_timeout=10.0)
+        try:
+            c.set_epoch(1, weights_delta=[6, 0, 0])
+            wait_synced(primary, standby)
+            assert standby.spec.weights_for(1) == (7, 1, 1)
+            primary.kill()
+            # promotion is demand-driven: this request fails over to the
+            # standby, which promotes and serves the re-weighted epoch
+            served = np.concatenate(list(c.epoch_batches(1)))
+            assert standby.role == "primary", "standby never promoted"
+        finally:
+            c.close()
+    finally:
+        primary.kill()
+        standby.stop()
+    ref = build_spec("prioritized", world=1).with_stream_weights(
+        {1: (7, 1, 1)})
+    assert np.array_equal(served, ref.rank_indices(1, 0))
+
+
+# ------------------------------------------- reshard + failover union laws
+def test_dedup_union_across_mid_epoch_reshard():
+    """A 2 -> 3 reshard mid-epoch-1 of a dedup stream: the union of all
+    deliveries is exactly epochs 0+1 of the global filtered stream —
+    nothing double-served (dedup's own law on top of exactly-once),
+    nothing dropped."""
+    spec = build_spec("dedup", world=2)
+    ref_spec = build_spec("dedup", world=1)
+    ref = np.concatenate([ref_spec.rank_indices(e, 0) for e in (0, 1)])
+    delivered = {}
+    lock = threading.Lock()
+    b_hit = threading.Barrier(2)
+    b_go = threading.Barrier(2)
+    with IndexServer(spec) as srv:
+        addr = srv.address
+
+        def worker(r):
+            got = []
+            c = ServiceIndexClient(addr, rank=r, batch=8,
+                                   backoff_base=0.01,
+                                   reconnect_timeout=20.0)
+            try:
+                got.extend(c.epoch_batches(0))
+                it = c.epoch_batches(1)
+                for _ in range(2):
+                    got.append(next(it))
+                b_hit.wait(timeout=30.0)
+                if r == 0:
+                    c.reshard(3)
+                b_go.wait(timeout=30.0)
+                got.extend(it)
+            finally:
+                with lock:
+                    delivered[r] = got
+                c.close()
+
+        def joiner():
+            c = ServiceIndexClient(addr, rank=None, batch=8,
+                                   backoff_base=0.01,
+                                   reconnect_timeout=20.0)
+            try:
+                got = list(c.epoch_batches(1))
+            finally:
+                with lock:
+                    delivered["j"] = got
+                c.close()
+
+        ths = [threading.Thread(target=worker, args=(r,)) for r in range(2)]
+        for t in ths:
+            t.start()
+        import time as _time
+        _time.sleep(0.6)
+        jt = threading.Thread(target=joiner)
+        jt.start()
+        for t in ths + [jt]:
+            t.join(60.0)
+            assert not t.is_alive(), "worker hung"
+        assert srv.generation == 1 and srv.spec.world == 3
+    union = Counter(int(x) for got in delivered.values()
+                    for arr in got for x in np.asarray(arr))
+    full = Counter(int(x) for x in ref)
+    missing = full - union
+    assert not missing, f"dropped: {sorted(missing)[:8]}"
+    extras = union - full
+    # wrap-pad allowance: whole samples, bounded by one reshard
+    assert sum(extras.values()) <= 3, f"extras: {extras}"
+    assert set(extras) <= set(full)
+
+
+def test_dedup_failover_bit_identical_with_snapshot_boundary():
+    """Primary killed between epochs: the promoted standby — whose
+    state carries the dedup boundary — serves epoch 1 bit-identically,
+    so across the failover nothing is re-served or dropped."""
+    spec = build_spec("dedup", world=1)
+    primary, standby = replicated_pair(spec)
+    try:
+        c = ServiceIndexClient(primary.address, rank=0, batch=16,
+                               backoff_base=0.01, reconnect_timeout=10.0)
+        try:
+            e0 = np.concatenate(list(c.epoch_batches(0)))
+            # force a state record so the standby holds the fold
+            primary._repl_append("state", state=primary._state_dict())
+            wait_synced(primary, standby)
+            sm = standby._state_dict().get("sampling") or {}
+            assert sm.get("dedup"), "standby state lost the seen-set"
+            primary.kill()
+            # promotion is demand-driven: this request fails over to the
+            # standby, which promotes and serves epoch 1 from the
+            # replicated boundary (no refold from epoch 0 needed)
+            e1 = np.concatenate(list(c.epoch_batches(1)))
+            assert standby.role == "primary", "standby never promoted"
+        finally:
+            c.close()
+    finally:
+        primary.kill()
+        standby.stop()
+    ref = build_spec("dedup", world=1)
+    assert np.array_equal(e0, ref.rank_indices(0, 0))
+    assert np.array_equal(e1, ref.rank_indices(1, 0))
+    assert not set(e0.tolist()) & set(e1.tolist()), "re-served across kill"
+
+
+def test_dedup_crash_recovery_from_disk(tmp_path):
+    """Restart-from-disk: the snapshotted seen-set boundary short-cuts
+    recovery, and the recovered server serves the identical stream."""
+    spec = build_spec("dedup", world=1)
+    snap = str(tmp_path / "snap.json")
+    wal = str(tmp_path / "wal")
+    srv = IndexServer(spec, port=0, snapshot_path=snap, wal_dir=wal)
+    srv.start()
+    host, port = srv.address
+    with ServiceIndexClient((host, port), rank=0, batch=16,
+                            backoff_base=0.01,
+                            reconnect_timeout=10.0) as c:
+        e0 = np.concatenate(list(c.epoch_batches(0)))
+        c.set_epoch(1)
+    srv.kill()
+    srv2 = IndexServer(build_spec("dedup", world=1), port=port,
+                       snapshot_path=snap, wal_dir=wal)
+    srv2.start()
+    try:
+        assert srv2.epoch == 1
+        with ServiceIndexClient((host, port), rank=0, batch=16,
+                                backoff_base=0.01,
+                                reconnect_timeout=10.0) as c:
+            e1 = np.concatenate(list(c.epoch_batches(1)))
+    finally:
+        srv2.stop()
+    ref = build_spec("dedup", world=1)
+    assert np.array_equal(e0, ref.rank_indices(0, 0))
+    assert np.array_equal(e1, ref.rank_indices(1, 0))
+    assert not set(e0.tolist()) & set(e1.tolist())
+
+
+# ------------------------------------------------------- cost-model plumb
+def test_fleetsim_prices_sampling_modes():
+    from partiallyshuffledistributedsampler_tpu.autopilot.priors import (
+        workload_key,
+    )
+    from partiallyshuffledistributedsampler_tpu.fleetsim.latency import (
+        RegenCostModel,
+    )
+
+    m = RegenCostModel()
+    n = 50_000_000
+    # dedup regen is host-bound: the device line must NOT look cheap
+    assert m.estimate_ms("xla", n, "dedup") == m.estimate_ms(
+        "native", n, "dedup")
+    assert m.pick(n, "dedup")[0] == m.host_backend
+    assert m.pick(n)[0] == "xla", "uniform crossover regressed"
+    assert m.pick(n, "weighted")[2]["sampling_mode"] == "weighted"
+    # priors: sampling workloads get their own warm-start keys, and
+    # uniform keys keep their historical form
+    uni = PartialShuffleSpec("plain", n=96, window=8, world=2)
+    assert workload_key(uni) == "n96:w2"
+    assert workload_key(build_spec("dedup", world=2)) == "n96:w2:sdedup"
+    assert (workload_key(build_spec("weighted", world=2))
+            == "n96:w2:sweighted")
+
+
+def test_telemetry_records_alias_fallback_event():
+    from partiallyshuffledistributedsampler_tpu import faults as F
+
+    telemetry.reset()
+    telemetry.configure(enabled=True)
+    try:
+        spec = build_spec("weighted")
+        plan = F.FaultPlan([F.FaultRule("sampling.alias_build", "error",
+                                        count=1)])
+        with plan, warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            got = spec.rank_indices(0, 0)
+        assert plan.fired("sampling.alias_build") >= 1
+        assert any("UNIFORM" in str(x.message) for x in w)
+        uniform = SamplingSpec.weighted(SIZES, (1, 1, 1), epoch_samples=T,
+                                        seed=7, window=8)
+        assert np.array_equal(got, uniform.rank_indices(0, 0))
+        names = [e["name"] for e in telemetry.recorder().snapshot()
+                 if e.get("name")]
+        assert "sampling_alias_fallback" in names
+    finally:
+        telemetry.reset()
+        telemetry.configure(enabled=False)
+
+
+# ------------------------------------------------------- fleetsim integration
+def test_fleetsim_cost_model_and_priors_know_sampling_modes():
+    """The simulator's regen cost lines and the autopilot's prior keys
+    distinguish the non-uniform modes: a dedup fold pays host-side work
+    on every backend, and a sampling workload never warm-starts a
+    uniform deployment of the same shape (or vice versa)."""
+    from partiallyshuffledistributedsampler_tpu.autopilot.priors import (
+        workload_key,
+    )
+    from partiallyshuffledistributedsampler_tpu.fleetsim import (
+        FleetSim,
+        RegenCostModel,
+    )
+    from partiallyshuffledistributedsampler_tpu.fleetsim.workload import (
+        uniform,
+    )
+
+    m = RegenCostModel()
+    n = 1 << 20
+    base_dev = m.estimate_ms("xla", n)
+    # dedup is host-bound regardless of backend: the fold's seen-set
+    # probes never ride the device
+    assert m.estimate_ms("xla", n, sampling_mode="dedup") > base_dev
+    assert (m.estimate_ms("xla", n, sampling_mode="dedup")
+            == m.estimate_ms("native", n, sampling_mode="dedup"))
+    # weighted/prioritized scale the per-sample rate, same shape
+    assert (m.estimate_ms("xla", n, sampling_mode="weighted")
+            == pytest.approx(base_dev * 1.0))
+    cand, _, info = m.pick(n, sampling_mode="dedup")
+    assert info["sampling_mode"] == "dedup"
+
+    # workload keys: uniform keeps its historical form, sampling
+    # modes get their own key space
+    uni = build_spec("weighted", world=2)
+    plain_key = f"n{uni.n}:w2"
+    assert workload_key(uni) == f"n{uni.n}:w2:sweighted"
+    assert workload_key(build_spec("dedup", world=2)).endswith(":sdedup")
+
+    class _PlainShape:
+        n, world = uni.n, 2
+
+    assert workload_key(_PlainShape()) == plain_key
+
+    # the sim threads the mode through to every cost estimate
+    sim = FleetSim(world=8, n_shards=2, n=1 << 16,
+                   workload=uniform(200.0), seed=3,
+                   sampling_mode="dedup")
+    sim.run(ticks=2)
+    assert sim.sampling_mode == "dedup"
