@@ -102,7 +102,8 @@ PY ?= python
 .PHONY: check test bench native dryrun service-smoke chaos-smoke \
 	elastic-smoke telemetry-smoke failover-smoke tenancy-smoke \
 	durability-smoke fused-smoke sharding-smoke capability-smoke \
-	streaming-smoke sampling-smoke autopilot-smoke sim-smoke analyze \
+	streaming-smoke sampling-smoke autopilot-smoke sim-smoke \
+	federation-smoke analyze \
 	analysis-smoke
 
 # the driver parses the LAST line of bench.py's combined output (round 3
@@ -229,6 +230,10 @@ autopilot-smoke:
 sim-smoke:
 	$(PY) -m pytest tests/test_fleetsim.py -q -m fleetsim -ra
 	$(PY) benchmarks/sim_smoke.py
+
+federation-smoke:
+	$(PY) -m pytest tests/test_federation.py -q -m federation -ra
+	$(PY) benchmarks/federation_smoke.py
 
 # static-analysis gate (docs/ANALYSIS.md): every lint pass over the
 # package + docs; any finding is a non-zero exit with file:line output

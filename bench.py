@@ -542,6 +542,20 @@ def main() -> None:
         except Exception as exc:
             details["simulator_error"] = repr(exc)[:200]
 
+    # detail tier: federation — client-observed failover across a whole
+    # home-cell kill + steady-state cross-cell WAL-shipping overhead vs
+    # the unfederated arm (methodology in benchmarks/federation_smoke.py)
+    if not smoke:
+        try:
+            sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+            from benchmarks.federation_smoke import (
+                summarize as federation_summarize,
+            )
+
+            details["federation"] = federation_summarize()
+        except Exception as exc:
+            details["federation_error"] = repr(exc)[:200]
+
     # detail tier: analysis — concurrency-sanitizer overhead: the
     # tracked-lock arm must stay within the raw-lock arm's rep noise
     # and record zero lock-order cycles (methodology in

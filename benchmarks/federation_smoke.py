@@ -1,0 +1,175 @@
+"""Multi-cell federation smoke: cell-kill failover latency + cross-cell
+shipping overhead.
+
+Two consumers:
+
+* ``make federation-smoke`` / ``python benchmarks/federation_smoke.py``
+  — the CI gate: a home/DR cell pair serves an epoch while the ENTIRE
+  home cell (every shard, every standby, the router) is hard-killed
+  mid-stream and the DR cell promoted; the client must ladder to the
+  promoted cell with zero degraded-mode entries and a stream
+  bit-identical to the unkilled reference, and steady-state cross-cell
+  WAL shipping must stay within the unfederated arm's own rep-to-rep
+  noise.  Exit 0 and one JSON line on success; raises loudly on any
+  miss.
+
+* ``bench.py`` imports :func:`summarize` — the ``details["federation"]``
+  tier: *failover_ms* (client-observed gap: last pre-kill batch → first
+  post-promotion batch) and *shipping overhead* (served epoch wall per
+  step, federated vs. a bare single-cell plane).
+
+Both figures describe the federation layer (docs/FEDERATION.md), not
+the network: everything runs on loopback, and the failover stall is
+dominated by the client's per-peer reconnect budget times the dead
+peers on its dial ladder (home shard, home router) — both tunables.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+#: a quiet machine's rep spread can be ~0; the overhead bar still needs
+#: slack for scheduler jitter on loaded CI boxes
+_NOISE_FLOOR_MS_PER_STEP = 0.05
+
+
+def _epoch_wall_ms(client, epoch):
+    t0 = time.perf_counter()
+    got = client.epoch_indices(epoch)
+    return (time.perf_counter() - t0) * 1e3, got
+
+
+def _shipping_overhead(*, n: int, window: int, batch: int,
+                       reps: int) -> dict:
+    """Served epoch wall per step, federated (cross-cell shipper
+    attached, write-through WAL at the DR cell) vs. a bare single-cell
+    plane.  The federated arm must land inside the bare arm's own
+    max-min rep spread — shipping rides a separate thread and must
+    never tax the serving path."""
+    from partiallyshuffledistributedsampler_tpu.federation import Federation
+    from partiallyshuffledistributedsampler_tpu.service import (
+        PartialShuffleSpec,
+        ServiceIndexClient,
+    )
+    from partiallyshuffledistributedsampler_tpu.sharding import ShardPlane
+
+    spec = PartialShuffleSpec.plain(n, window=window, seed=0, world=1)
+    ref = np.asarray(spec.rank_indices(1, 0))
+    steps = -(-n // batch)
+    solo_ms, fed_ms = [], []
+
+    with ShardPlane(spec, 1) as plane:
+        with ServiceIndexClient(plane.address, rank=0, batch=batch) as c:
+            _epoch_wall_ms(c, 1)  # warm the epoch array cache
+            for _ in range(reps):
+                ms, got_solo = _epoch_wall_ms(c, 1)
+                solo_ms.append(ms)
+
+    with tempfile.TemporaryDirectory() as root:
+        with Federation(spec, root=root) as fed:
+            fed.wait_synced()
+            with ServiceIndexClient(fed.address, rank=0, batch=batch) as c:
+                _epoch_wall_ms(c, 1)
+                for _ in range(reps):
+                    ms, got_fed = _epoch_wall_ms(c, 1)
+                    fed_ms.append(ms)
+
+    if not (np.array_equal(got_solo, ref) and np.array_equal(got_fed, ref)):
+        raise AssertionError("served stream changed under federation — "
+                             "cross-cell shipping must never touch the data")
+    noise = max((max(solo_ms) - min(solo_ms)) / steps,
+                _NOISE_FLOOR_MS_PER_STEP)
+    delta = (float(np.median(fed_ms)) - float(np.median(solo_ms))) / steps
+    return {
+        "solo_ms_per_step": round(float(np.median(solo_ms)) / steps, 5),
+        "federated_ms_per_step": round(float(np.median(fed_ms)) / steps, 5),
+        "noise_ms_per_step": round(noise, 5),
+        "overhead_ms_per_step": round(delta, 5),
+        "within_noise": bool(delta <= noise),
+        "reps": reps, "steps": steps,
+    }
+
+
+def _cell_kill_drill(*, n: int, window: int, batch: int,
+                     reconnect_timeout: float = 2.0) -> dict:
+    """Kill the whole home cell mid-epoch, promote the DR cell, and
+    time the client-observed stall (last pre-kill batch -> first batch
+    served by the promoted cell).  The stream must be bit-identical to
+    the unkilled reference with zero degraded entries — the latency
+    blip is the only symptom (docs/FEDERATION.md "the DR law")."""
+    from partiallyshuffledistributedsampler_tpu.federation import Federation
+    from partiallyshuffledistributedsampler_tpu.service import (
+        PartialShuffleSpec,
+        ServiceIndexClient,
+    )
+
+    spec = PartialShuffleSpec.plain(n, window=window, seed=0, world=1)
+    ref = np.asarray(spec.rank_indices(0, 0))
+    with tempfile.TemporaryDirectory() as root:
+        with Federation(spec, root=root) as fed:
+            fed.wait_synced()
+            client = ServiceIndexClient(fed.address, rank=0, batch=batch,
+                                        backoff_base=0.02,
+                                        reconnect_timeout=reconnect_timeout)
+            try:
+                it = client.epoch_batches(0)
+                got = [next(it) for _ in range(3)]
+                # the shipped tail must be drained BEFORE the kill, so
+                # the drill measures failover, not catch-up
+                if not fed.wait_shipped(10.0):
+                    raise AssertionError("shipped tail never drained")
+                t0 = time.perf_counter()
+                fed.kill_cell(fed.home_id)
+                fed.promote(fed.dr_id, dead=fed.home_id)
+                got.append(next(it))
+                failover_ms = (time.perf_counter() - t0) * 1e3
+                got.extend(it)
+                counters = client.metrics.report()["counters"]
+            finally:
+                client.close()
+            fcounters = fed.metrics.report()["counters"]
+    if not np.array_equal(np.concatenate(got), ref):
+        raise AssertionError("stream diverged across the cell kill")
+    if counters.get("degraded_mode", 0):
+        raise AssertionError("a cell kill must not enter degraded mode")
+    if fcounters.get("federation_failovers", 0) < 1:
+        raise AssertionError("the drill never actually promoted")
+    return {
+        "failover_ms": round(failover_ms, 3),
+        "federation_failovers": int(fcounters.get("federation_failovers", 0)),
+        "cell_fenced": int(fcounters.get("cell_fenced", 0)),
+        "reconnect_timeout_s": reconnect_timeout,
+    }
+
+
+def summarize(*, n: int = 50_000, window: int = 256, batch: int = 256,
+              reps: int = 5) -> dict:
+    """The bench.py ``details["federation"]`` tier: shipping overhead
+    plus one cell-kill drill."""
+    return {
+        "overhead": _shipping_overhead(n=n, window=window, batch=batch,
+                                       reps=reps),
+        "drill": _cell_kill_drill(n=n, window=window, batch=batch),
+    }
+
+
+def main() -> None:
+    """The `make federation-smoke` gate: hard assertions on both legs."""
+    out = summarize()
+    assert out["overhead"]["within_noise"], (
+        "steady-state cross-cell shipping cost exceeded the unfederated "
+        f"arm's noise floor: {out['overhead']!r}")
+    assert out["drill"]["failover_ms"] > 0
+    print(json.dumps({"federation_smoke": "ok", **out}))
+
+
+if __name__ == "__main__":
+    main()

@@ -73,6 +73,7 @@ _DOC_TOKEN_PASSTHROUGH = frozenset({
     # typed error codes documented next to the counters they bump
     "tenant_admission", "spec_mismatch", "capability_unsupported",
     "horizon_pending", "horizon_advance", "stream_append", "wrong_shard",
+    "wrong_cell",
     # streaming-mode kwarg/helper/wire vocabulary (docs/STREAMING.md)
     "capability_stream_batches", "stream_seq", "weights_delta",
     # capability-mode kwarg/helper/wire vocabulary (docs/CAPABILITY.md)

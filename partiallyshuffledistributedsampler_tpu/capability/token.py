@@ -69,6 +69,13 @@ class EpochCapability:
     #: datasets and plain-base streams (and absent from the canonical
     #: encoding then, so pre-streaming capabilities verify unchanged)
     stream_weights: Optional[tuple] = None
+    #: federated issuance (docs/FEDERATION.md): the issuing cell and its
+    #: signing-key id, so a cross-cell verifier can pick the right key
+    #: from its trust bundle; None on unfederated deployments (and
+    #: absent from the canonical encoding then, so every pre-federation
+    #: capability's signature verifies unchanged)
+    cell: Optional[str] = None
+    kid: Optional[int] = None
     sig: str = ""
 
     # ------------------------------------------------------------- encoding
@@ -91,6 +98,12 @@ class EpochCapability:
             # every pre-streaming capability's canonical bytes (and
             # therefore its signature) byte-identical
             out["stream_weights"] = [int(x) for x in self.stream_weights]
+        if self.cell is not None:
+            # additive, same rule: only federated issuers stamp their
+            # cell and key id into the signed bytes
+            out["cell"] = str(self.cell)
+        if self.kid is not None:
+            out["kid"] = int(self.kid)
         return out
 
     def canonical(self) -> bytes:
@@ -146,6 +159,10 @@ class EpochCapability:
                 stream_weights=(
                     None if wire.get("stream_weights") is None
                     else tuple(int(x) for x in wire["stream_weights"])),
+                cell=(None if wire.get("cell") is None
+                      else str(wire["cell"])),
+                kid=(None if wire.get("kid") is None
+                     else int(wire["kid"])),
                 sig=str(wire.get("sig", "")),
             )
         except (KeyError, TypeError, ValueError) as exc:

@@ -49,6 +49,9 @@ SITES = frozenset({
     "shard.migrate",         # the two-phase cross-shard rank handoff
     "sim.event",             # fleetsim dispatching one queued event
     "sim.inject",            # fleetsim applying a scenario injection
+    "cell.ship",             # the cross-cell WalShipper framing one batch
+    "cell.fence",            # fencing one server of a superseded cell
+    "cell.migrate",          # the two-phase cross-cell tenant cutover
 })
 
 #: what a firing rule does (interpreted by runtime.perform / the sites)

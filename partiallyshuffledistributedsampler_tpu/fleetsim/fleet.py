@@ -41,6 +41,7 @@ from typing import Optional
 
 from .. import faults as F
 from ..autopilot.policy import AutopilotPolicy, Decision, PolicyConfig
+from ..federation.directory import CellDirectory
 from ..service.backpressure import BackpressurePolicy
 from ..sharding.shardmap import ShardMap
 from ..utils.metrics import MetricsRegistry
@@ -68,7 +69,8 @@ class FleetSim:
                  regen_cost: Optional[RegenCostModel] = None,
                  interval_s: float = 1.0, batch0: int = 1024,
                  backend: str = "native",
-                 sampling_mode: Optional[str] = None) -> None:
+                 sampling_mode: Optional[str] = None,
+                 cells: Optional[tuple] = None) -> None:
         self.world = int(world)
         self.n = int(n)
         self.workload = workload
@@ -95,6 +97,18 @@ class FleetSim:
         #: (docs/SAMPLING.md) — shifts the regen cost lines (the dedup
         #: fold is host-side work) and the priors' workload key
         self.sampling_mode = sampling_mode
+        #: federated overlay (docs/FEDERATION.md): a (home, dr) cell
+        #: pair builds a real CellDirectory over synthetic addresses so
+        #: the cell-kill scenario flips the SAME versioned value object
+        #: a live federation installs at promotion
+        self.cell_directory: Optional[CellDirectory] = None
+        self.cell: Optional[str] = None
+        if cells is not None:
+            home_c, dr_c = str(cells[0]), str(cells[1])
+            self.cell_directory = CellDirectory(
+                {home_c: (f"sim-{home_c}", 0), dr_c: (f"sim-{dr_c}", 0)},
+                default=home_c, dr={home_c: dr_c, dr_c: home_c})
+            self.cell = home_c
         self.ticks = 0
         self.window_stats: dict = {}   # sid -> last window's fluid state
         self._backlog: dict = {}       # sid -> carried retry backlog (rpcs)
@@ -129,6 +143,30 @@ class FleetSim:
             lambda: self._slow.__setitem__(sid, float(factor))),
             label="inject:slow_shard")
 
+    def inject_cell_kill(self, at_s: float) -> None:
+        """Schedule the DR drill at ``at_s``: the whole home cell dies —
+        the directory flips every tenant to the DR partner in one
+        version bump (``CellDirectory.flip_cell``, the exact transform a
+        live ``Federation.promote`` installs), the fleet re-dials there,
+        and one sampled failover barrier freezes EVERY shard's next
+        window (clients ladder + mirrors promote; docs/FEDERATION.md)."""
+        if self.cell_directory is None:
+            raise RuntimeError(
+                "cell-kill needs FleetSim(cells=(home, dr))")
+        self.loop.at(at_s, lambda: self._inject(self._cell_kill),
+                     label="inject:cell_kill")
+
+    def _cell_kill(self) -> None:
+        dead = self.cell
+        to = self.cell_directory.dr_for(dead)
+        if to is None:
+            self.registry.inc("sim_actuation_errors")
+            return
+        self.cell_directory = self.cell_directory.flip_cell(dead, to)
+        self.cell = to
+        self._freeze(*self.live_shards())
+        self.registry.inc("sim_cell_kills")
+
     def _inject(self, apply) -> None:
         try:
             F.fire("sim.inject")
@@ -149,10 +187,17 @@ class FleetSim:
         self.ticks += 1
         self.registry.inc("sim_ticks")
         self.registry.inc("sim_decisions", len(actuated))
+        extra = None
+        if self.cell_directory is not None:
+            extra = {"cell": self.cell,
+                     "directory_version": self.cell_directory.version,
+                     "directory_fingerprint":
+                         self.cell_directory.fingerprint()}
         self.trace.append(
             tick=self.ticks, now=now, obs=obs, decisions=actuated,
             pstate=self.policy.state_dict(),
-            map_fingerprint=self.map.fingerprint())
+            map_fingerprint=self.map.fingerprint(),
+            extra=extra)
         self.loop.after(self.interval_s, self._tick, label="tick")
 
     # ------------------------------------------------------------ observe
@@ -299,7 +344,12 @@ class FleetSim:
                 if hi > lo]
 
     def status(self) -> dict:
+        out = {}
+        if self.cell_directory is not None:
+            out["cell"] = self.cell
+            out["directory_version"] = self.cell_directory.version
         return {
+            **out,
             "now": self.clock(),
             "ticks": self.ticks,
             "map": self.map.to_wire(),

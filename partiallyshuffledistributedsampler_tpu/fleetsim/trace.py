@@ -64,7 +64,8 @@ class DecisionTrace:
 
     def append(self, *, tick: int, now: float, obs: dict,
                decisions: Iterable[Decision], pstate: dict,
-               map_fingerprint: str = "") -> dict:
+               map_fingerprint: str = "",
+               extra: Optional[dict] = None) -> dict:
         e = {
             "tick": int(tick),
             "now": float(now),
@@ -73,6 +74,12 @@ class DecisionTrace:
             "pstate": dict(pstate),
             "map_fingerprint": str(map_fingerprint),
         }
+        if extra:
+            # additive overlay keys (e.g. the federation's per-tick cell
+            # + directory version, docs/FEDERATION.md); callers must not
+            # shadow the core keys above — entry shape without an
+            # overlay is unchanged, so existing traces stay byte-stable
+            e.update(extra)
         self.entries.append(e)
         return e
 

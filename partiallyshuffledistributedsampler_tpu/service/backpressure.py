@@ -39,6 +39,7 @@ DEFAULT_RETRY_MS = {
     "stream_append": 25,       # injected/failed APPEND; replay dedupes
     "horizon_gate": 25,        # horizon not appended / advance pending
     "wrong_shard": 25,         # re-route via the attached shard map
+    "wrong_cell": 25,          # re-route via the attached cell directory
 }
 
 #: shed-arm ceiling: scaled hints never exceed this (a runaway controller

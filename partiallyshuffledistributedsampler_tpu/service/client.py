@@ -86,6 +86,7 @@ from ..capability import (
     replay_trail,
 )
 from ..telemetry import enabled as _tel_enabled, span as _span
+from ..tenancy import tenant_id_for
 from ..utils.retry import RetryPolicy
 from . import protocol as P
 from .metrics import ServiceMetrics
@@ -216,6 +217,10 @@ class ServiceIndexClient:
                  lease eviction and lazy drain commits behave
                  identically with and without batch flow.
     clock:       injectable monotonic clock for that cadence (tests).
+    cell_directory: optional seed for the federation's tenant → cell
+                 namespace (a ``CellDirectory``, or its wire dict); the
+                 live one is adopted from WELCOMEs and ``wrong_cell``
+                 refusals, version-gated (docs/FEDERATION.md).
     """
 
     def __init__(
@@ -237,6 +242,7 @@ class ServiceIndexClient:
         clock=None,
         attach: bool = False,
         auto_batch: bool = False,
+        cell_directory=None,
     ) -> None:
         self.address = _parse_address(address)
         self.rank = None if rank is None else int(rank)
@@ -305,6 +311,16 @@ class ServiceIndexClient:
         #: WELCOME — the fallback re-route target when an adopted map
         #: carries no address for our shard
         self._router_address: Optional[tuple] = None
+        #: the federation's cell directory (raw wire dict), seeded from
+        #: the ctor and refreshed by WELCOME / ``wrong_cell`` refusals;
+        #: ``None`` on an unfederated deployment (docs/FEDERATION.md)
+        self.cell_directory: Optional[dict] = (
+            None if cell_directory is None
+            else (cell_directory.to_wire()
+                  if hasattr(cell_directory, "to_wire")
+                  else dict(cell_directory)))
+        #: which cell the current connection serves in, from WELCOME
+        self.cell: Optional[str] = None
         self.spec_wire: Optional[dict] = None
         self.server_epoch: Optional[int] = None
         self._sock: Optional[socket.socket] = None
@@ -407,6 +423,54 @@ class ServiceIndexClient:
             self.close()
             self.address = target
 
+    def _adopt_cell_directory(self, wire) -> bool:
+        """Version-gated directory adoption — the ``_adopt_shard_map``
+        rule one layer up: a stale wire copy riding a delayed refusal
+        must never roll the global namespace back."""
+        if not wire:
+            return False
+        cur = self.cell_directory
+        if cur is not None and \
+                int(wire.get("version", 1)) < int(cur.get("version", 1)):
+            return False
+        self.cell_directory = dict(wire)
+        return True
+
+    def _cell_addr(self, cell) -> Optional[tuple]:
+        d = self.cell_directory
+        if d is None or cell is None:
+            return None
+        a = (d.get("cells") or {}).get(str(cell))
+        return None if a is None else _parse_address(tuple(a))
+
+    def _home_cell(self) -> Optional[str]:
+        """Our tenant's home cell per the adopted directory (the
+        directory default when no explicit row names us)."""
+        d = self.cell_directory
+        if d is None:
+            return None
+        tenant = self.tenant
+        if tenant is None and self.expected_spec is not None:
+            tenant = tenant_id_for(
+                self.expected_spec.fingerprint(include_world=False))
+        if tenant is not None:
+            home = (d.get("tenants") or {}).get(str(tenant))
+            if home is not None:
+                return home
+        return d.get("default")
+
+    def _on_wrong_cell(self, hdr: dict) -> None:
+        """A cell refused our tenant: adopt the attached (fresh)
+        directory and re-point at the home cell's entry address
+        (docs/FEDERATION.md "Cell directory")."""
+        self._adopt_cell_directory(hdr.get("cell_directory"))
+        self.metrics.inc("wrong_cell_redirects", self.rank)
+        target = self._cell_addr(hdr.get("home")) or \
+            self._cell_addr(self._home_cell())
+        if target is not None and target != self.address:
+            self.close()
+            self.address = target
+
     def _connect(self) -> None:
         last_refusal = None
         for _ in range(self._MAX_REDIRECT_HOPS):
@@ -416,7 +480,7 @@ class ServiceIndexClient:
         if last_refusal is not None:
             # still ping-ponging (a staggered commit in flight): surface
             # the typed refusal so the retry layer paces the re-route
-            raise _typed_error("wrong_shard",
+            raise _typed_error(last_refusal.get("code", "wrong_shard"),
                                last_refusal.get("detail", ""), last_refusal)
         raise ServiceUnavailable(
             f"still redirected toward {self.address} after "
@@ -467,6 +531,11 @@ class ServiceIndexClient:
             if header.get("code") == "wrong_shard":
                 self._on_wrong_shard(header)
                 return False, header
+            if header.get("code") == "wrong_cell":
+                # our tenant is homed at another cell: re-point at its
+                # entry address and loop (docs/FEDERATION.md)
+                self._on_wrong_cell(header)
+                return False, header
             raise _typed_error(header.get("code", "error"),
                                header.get("detail", ""), header)
         if self._attach and msg == P.MSG_OK:
@@ -483,6 +552,13 @@ class ServiceIndexClient:
             raise P.ProtocolError(
                 f"expected WELCOME, got {P.msg_name(msg)}"
             )
+        c = header.get("cell")
+        if c is not None:
+            # federated deployment: remember the serving cell and adopt
+            # the directory BEFORE the router early-return — a router
+            # WELCOME carries the namespace too (docs/FEDERATION.md)
+            self.cell = str(c)
+            self._adopt_cell_directory(header.get("cell_directory"))
         if header.get("router"):
             # a ShardRouter answered: it never serves data — remember it,
             # adopt the map it carries and direct-connect the owning
@@ -679,11 +755,13 @@ class ServiceIndexClient:
                         if not op.pause(min_delay=retry_s):
                             raise
                         continue
-                    if exc.code in ("wrong_shard", "router_route"):
+                    if exc.code in ("wrong_shard", "router_route",
+                                    "wrong_cell"):
                         # shard-map churn (a staggered cross-shard commit
-                        # ping-pongs a migrating rank between owners) or
-                        # an injected route fault: the re-route already
-                        # happened in _connect — pace and re-dial
+                        # ping-pongs a migrating rank between owners), an
+                        # injected route fault, or a cross-cell redirect
+                        # mid-flip: the re-route already happened in
+                        # _connect — pace and re-dial
                         retry_s = float(
                             exc.header.get("retry_ms", 25)) / 1e3
                         if not op.pause(min_delay=retry_s):
@@ -780,6 +858,18 @@ class ServiceIndexClient:
                         raise ServiceError(code, rheader.get("detail", ""),
                                            rheader)
                     continue
+                if code == "wrong_cell":
+                    # our tenant migrated cells mid-stream: adopt the
+                    # fresh directory, re-point at the new home cell and
+                    # re-HELLO there — the cursor law makes the replay
+                    # exactly-once (docs/FEDERATION.md)
+                    self.close()
+                    self._on_wrong_cell(rheader)
+                    retry_s = float(rheader.get("retry_ms", 25)) / 1e3
+                    if not op.pause(min_delay=retry_s):
+                        raise ServiceError(code, rheader.get("detail", ""),
+                                           rheader)
+                    continue
                 if code in ("horizon_pending", "horizon_advance",
                             "stream_append"):
                     # moving-horizon backpressure (docs/STREAMING.md):
@@ -843,7 +933,21 @@ class ServiceIndexClient:
         ra = self._router_address
         if ra is not None and ra not in tried and ra != self.address:
             return ra
+        # cell-aware dial ladder (docs/FEDERATION.md): past the in-cell
+        # peers, re-look-up our home cell in the adopted directory, then
+        # knock on its DR partner — the whole home cell may be gone
+        home = self._home_cell()
+        for cell in (home, self._dr_cell(home)):
+            a = self._cell_addr(cell)
+            if a is not None and a not in tried and a != self.address:
+                return a
         return None
+
+    def _dr_cell(self, cell) -> Optional[str]:
+        d = self.cell_directory
+        if d is None or cell is None:
+            return None
+        return (d.get("dr") or {}).get(str(cell))
 
     def _begin_failover(self, peer: tuple, tried: set):
         """Point the client at ``peer`` under a FRESH retry deadline and
@@ -1541,7 +1645,7 @@ class ServiceIndexClient:
         problem = None
         if self.capability_secret is None:
             problem = "client has no capability_secret to verify with"
-        elif not cap.verify(self.capability_secret):
+        elif not self._cap_signature_ok(cap):
             problem = "HMAC signature check failed"
         elif spec is not None and \
                 cap.fingerprint != spec.fingerprint(include_world=False):
@@ -1558,6 +1662,18 @@ class ServiceIndexClient:
         if problem is not None:
             self.metrics.inc("capability_rejects", self.rank)
             raise CapabilityError(f"capability refused: {problem}")
+
+    def _cap_signature_ok(self, cap: EpochCapability) -> bool:
+        """Dispatch the HMAC check on the secret's shape: a federated
+        ``TrustBundle``/``CellKeyring`` resolves ``(cap.cell, cap.kid)``
+        to a per-cell key (an unknown cell or a retired kid raises the
+        loud re-issue ``CapabilityError``); a plain secret verifies
+        directly (docs/FEDERATION.md "Federated capabilities")."""
+        secret = self.capability_secret
+        if hasattr(secret, "secret_for"):
+            from ..federation.keys import verify_capability
+            return verify_capability(secret, cap)
+        return cap.verify(secret)
 
     def capability_epoch_batches(self, epoch: int, *, spec=None,
                                  start_seq: int = 0

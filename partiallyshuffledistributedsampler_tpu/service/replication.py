@@ -201,7 +201,19 @@ class ReplicationShipper:
     ``term_fn`` the current fencing term (stamped into every frame);
     ``on_fenced(term)`` is called when the standby answers with a newer
     term — the server uses it to fence itself (it has been superseded).
+
+    The class attributes name the wire fault site and the metric family,
+    so the cross-cell :class:`~..federation.WalShipper` — the same loop
+    pointed at a remote cell — observes under its own names without
+    duplicating the ship/fence/resync machinery.
     """
+
+    #: fault-injection site armed on every outbound frame (None = none);
+    #: the federation shipper overrides with "cell.ship"
+    SITE: Optional[str] = None
+    M_SHIPPED = "repl_shipped"
+    M_RESYNCS = "repl_resyncs"
+    M_LAG_MS = "repl_lag_ms"
 
     def __init__(
         self,
@@ -267,7 +279,7 @@ class ReplicationShipper:
                     self.log.clear_resync()
                     self._close()  # next tick reconnects and re-SYNCs
                     if self._metrics is not None:
-                        self._metrics.inc("repl_resyncs")
+                        self._metrics.inc(self.M_RESYNCS)
                     continue
                 # an empty append doubles as the feed-freshness heartbeat
                 self._ship(P.MSG_REPL_APPEND, {
@@ -276,7 +288,7 @@ class ReplicationShipper:
                     "records": recs,
                 })
                 if recs and self._metrics is not None:
-                    self._metrics.inc("repl_shipped", value=len(recs))
+                    self._metrics.inc(self.M_SHIPPED, value=len(recs))
             except _Fenced:
                 return  # superseded: on_fenced already ran; stop shipping
             except (ConnectionError, socket.timeout, OSError,
@@ -300,12 +312,22 @@ class ReplicationShipper:
         self.shipped_lsn = lsn
         self.log.clear_resync()
         self._backoff = 0.05
+        if self.synced.is_set() and self._metrics is not None:
+            # any sync after the bootstrap is a RE-sync: a torn frame or
+            # dropped link forced the full-state handshake again
+            self._metrics.inc(self.M_RESYNCS)
         self.synced.set()
         telemetry.event("repl_sync", lsn=lsn)
 
+    def _send_frame(self, msg_type: int, header: dict) -> None:
+        """One framed send on the replication link.  Subclasses override
+        to arm their own wire fault site (the `fault-sites` lint needs
+        the site literal at the send)."""
+        P.send_msg(self._sock, msg_type, header, site=self.SITE)
+
     def _ship(self, msg_type: int, header: dict) -> None:
         t0 = time.perf_counter()
-        P.send_msg(self._sock, msg_type, header)
+        self._send_frame(msg_type, header)
         reply, rheader, _ = P.recv_msg(self._sock)
         if reply == P.MSG_ERROR:
             code = rheader.get("code")
@@ -320,7 +342,7 @@ class ReplicationShipper:
             if code == "repl_gap":
                 self._close()  # reconnect path re-SYNCs
                 if self._metrics is not None:
-                    self._metrics.inc("repl_resyncs")
+                    self._metrics.inc(self.M_RESYNCS)
                 return
             raise P.ProtocolError(
                 f"standby refused {P.msg_name(msg_type)}: {code!r}")
@@ -328,7 +350,7 @@ class ReplicationShipper:
         if applied is not None:
             self.shipped_lsn = max(self.shipped_lsn, int(applied))
         if self._metrics is not None:
-            self._metrics.registry.histogram("repl_lag_ms").observe(
+            self._metrics.registry.histogram(self.M_LAG_MS).observe(
                 (time.perf_counter() - t0) * 1e3)
 
 

@@ -198,6 +198,8 @@ class IndexServer(DispatchListener):
         fsync: str = "group_commit",
         capability_secret=None,
         backpressure: Optional[BackpressurePolicy] = None,
+        cell_id: Optional[str] = None,
+        cell_directory=None,
     ) -> None:
         if max_inflight < 1:
             raise ValueError(f"max_inflight must be >= 1, got {max_inflight}")
@@ -212,6 +214,19 @@ class IndexServer(DispatchListener):
         #: shed arm scales the whole table with observed queue depth
         self.backpressure = (backpressure if backpressure is not None
                              else BackpressurePolicy())
+        # ---- multi-cell federation (docs/FEDERATION.md) ----
+        #: which cell this server serves in; None on unfederated
+        #: deployments (the cell gate and WELCOME fields then cost
+        #: zero wire bytes)
+        self.cell_id = None if cell_id is None else str(cell_id)
+        #: the shared directory holder (``DirectoryRef``-like: its
+        #: ``current()`` yields the live ``CellDirectory``) or a static
+        #: directory value; consulted at every HELLO
+        self._cell_directory = cell_directory
+        #: the cell-cutover barrier (``freeze_writes``): while set,
+        #: mutating client ops answer the retryable ``reshard`` refusal
+        #: so a migration can ship a stable WAL tail
+        self._cell_frozen = threading.Event()
         # ---- autopilot knobs (docs/AUTOPILOT.md) ----
         #: transport-batch size recommended to clients; None until a
         #: controller tunes it (zero WELCOME/heartbeat bytes until then)
@@ -748,7 +763,12 @@ class IndexServer(DispatchListener):
         a hot standby applies.  Runs before the socket binds;
         :func:`~..durability.recover_unstarted` drives it directly for
         the crash matrix.  Returns the replay stats dict."""
-        if (self.wal_dir is not None and self.role == "primary"
+        # a standby with a wal_dir opens its OWN log too: the receive
+        # side of cross-cell shipping persists applied records so a DR
+        # cell can recover a tenant from its local tail alone
+        # (docs/FEDERATION.md "Cross-cell shipping")
+        if (self.wal_dir is not None
+                and self.role in ("primary", "standby")
                 and self._wal is None):
             self._wal = WriteAheadLog(self.wal_dir,
                                       fsync=self.fsync_policy,
@@ -765,7 +785,15 @@ class IndexServer(DispatchListener):
         if self._wal is None:
             return {"replayed": 0, "skipped": 0, "last_lsn": 0,
                     "replay_ms": 0.0}
-        return replay_wal_tail(self)
+        stats = replay_wal_tail(self)
+        if self.role == "standby":
+            # a restarted DR standby resumes its applied prefix from its
+            # shipped-tail WAL; the feed's lsn-overlap check then makes
+            # any re-shipped records idempotent
+            with self._lock:
+                self._applied_lsn = max(int(self._applied_lsn),
+                                        int(self._wal.last_lsn))
+        return stats
 
     def _restore_from_disk(self) -> None:
         """Restore from ``snapshot_path``.  Without a WAL this is the
@@ -1029,6 +1057,16 @@ class IndexServer(DispatchListener):
                     eng.role = "primary"
                     eng.term = self.term
                     eng._promote_local_state_locked()
+            if self._wal is not None and self._repl_log is None:
+                # a DR standby promoting over its shipped-tail WAL
+                # becomes a durable primary on the spot: new transitions
+                # write through to the SAME on-disk sequence the feed
+                # left off at (docs/FEDERATION.md "Cell-kill recovery")
+                self._repl_log = ReplicationLog(metrics=self.metrics,
+                                                wal=self._wal)
+                for eng in self._engines():
+                    eng._repl_log = TenantTaggedLog(self._repl_log,
+                                                    eng.tenant_id)
             self.metrics.inc("promotions")
             term = self.term
         telemetry.event("promoted", term=term)
@@ -1099,6 +1137,74 @@ class IndexServer(DispatchListener):
             pass
         self.metrics.inc("fenced_writes")
         return refusal
+
+    # ------------------------------------------------ multi-cell federation
+    def _cell_dir(self):
+        """The live ``CellDirectory`` this server consults, or None when
+        unfederated.  ``cell_directory`` is duck-typed: a
+        ``DirectoryRef``-like holder (has ``current()``) or a static
+        directory value — so the service layer never imports
+        ``federation`` (docs/FEDERATION.md)."""
+        d = self._cell_directory
+        if d is None:
+            return None
+        return d.current() if hasattr(d, "current") else d
+
+    def _cell_fields(self) -> dict:
+        """Additive WELCOME fields naming this server's cell and the
+        directory wire form — a federated client learns the global
+        namespace from its very first claim; zero bytes unfederated."""
+        if self.cell_id is None:
+            return {}
+        out = {"cell": self.cell_id}
+        d = self._cell_dir()
+        if d is not None:
+            out["cell_directory"] = d.to_wire()
+        return out
+
+    def _cell_refusal(self, header: dict) -> Optional[dict]:
+        """The cell gate on HELLO (docs/FEDERATION.md "Cell directory"):
+        a tenant homed at another cell gets the typed retryable
+        ``wrong_cell`` redirect carrying the home cell and the directory
+        wire form — ``wrong_shard``'s exact shape, one layer up.  A
+        failover HELLO is exempt: a client whose home cell just died
+        must be able to knock at the DR cell BEFORE the directory
+        flips — the promotion gate (feed staleness) is the safety
+        there, not the gate."""
+        if self.cell_id is None or header.get("failover"):
+            return None
+        d = self._cell_dir()
+        if d is None:
+            return None
+        tenant = header.get("tenant")
+        if tenant is None:
+            fp = header.get("spec_fingerprint")
+            tenant = (tenant_id_for(str(fp)) if fp is not None
+                      else self.tenant_id)
+        home = d.home(str(tenant))
+        if home == self.cell_id:
+            return None
+        self.metrics.inc("cell_redirects")
+        return {
+            "code": "wrong_cell",
+            "retry_ms": self.backpressure.retry_ms("wrong_cell"),
+            "cell": self.cell_id,
+            "home": home,
+            "cell_directory": d.to_wire(),
+            "detail": f"tenant {tenant} is homed at cell {home!r}; this "
+                      f"is cell {self.cell_id!r} (directory v{d.version})",
+        }
+
+    def freeze_writes(self, on: bool = True) -> None:
+        """The migration cutover barrier (docs/FEDERATION.md "Live
+        migration"): while frozen, mutating client ops answer the
+        retryable ``reshard`` refusal — HELLO excepted, so redirected
+        clients can still land and wait — and the WAL tail goes
+        quiescent so the shipper can drain it to the target cell."""
+        if on:
+            self._cell_frozen.set()
+        else:
+            self._cell_frozen.clear()
 
     def _apply_state_locked(self, state: dict) -> None:
         """Adopt a full replicated state dict (REPL_SYNC bootstrap, or a
@@ -1318,12 +1424,14 @@ class IndexServer(DispatchListener):
                               f"prefix ends at {self._applied_lsn}",
                 })
                 return
+            fresh = []
             for rec in recs:
                 lsn = int(rec.get("lsn", 0))
                 if lsn <= self._applied_lsn:
                     continue  # idempotent overlap after a re-SYNC
                 self._apply_record_locked(rec)
                 self._applied_lsn = lsn
+                fresh.append(rec)
             applied = self._applied_lsn
             seal, self._seal_pending = self._seal_pending, False
             sealed = []
@@ -1331,6 +1439,18 @@ class IndexServer(DispatchListener):
                 if eng._seal_pending:
                     eng._seal_pending = False
                     sealed.append(eng)
+        wal = self._wal
+        if wal is not None:
+            # receive-side write-through (docs/FEDERATION.md): a standby
+            # with its own WAL persists each applied record before the
+            # ack, so the shipped tail survives this cell losing its
+            # feed.  The lsn guard keeps the on-disk sequence dense
+            # through re-SYNC overlaps; noop fillers absorb any lsns the
+            # feed's cursor coalescing skipped.  Outside self._lock —
+            # the primary's append path orders repl-log before WAL too.
+            for rec in fresh:
+                if int(rec.get("lsn", 0)) > wal.last_lsn:
+                    wal.append(rec)
         if seal:
             self._write_snapshot(force=True)
         for eng in sealed:
@@ -1591,6 +1711,19 @@ class IndexServer(DispatchListener):
                 _annotate(error_code="fenced")
                 P.send_msg(sock, P.MSG_ERROR, refusal)
                 return
+            if self._cell_frozen.is_set() and msg != P.MSG_HELLO:
+                # migration cutover freeze (docs/FEDERATION.md): the
+                # same retryable refusal a reshard barrier uses, so the
+                # client's existing retry arm pauses through the flip;
+                # HELLO stays live — a redirected client must be able
+                # to land and learn the post-flip directory
+                P.send_msg(sock, P.MSG_ERROR, {
+                    "code": "reshard",
+                    "phase": "cell_freeze",
+                    "retry_ms": self.backpressure.retry_ms("reshard_freeze"),
+                    "detail": "cell cutover in progress; retry shortly",
+                })
+                return
         # tenant routing: the connection's HELLO binding wins; an
         # explicit additive ``tenant`` header field (mirroring ``trace``)
         # can name the namespace when a connection serves ops traffic
@@ -1824,6 +1957,17 @@ class IndexServer(DispatchListener):
             w = self.spec.weights_for(int(epoch))
             if w is not None:
                 extra["stream_weights"] = tuple(int(x) for x in w)
+        secret = self.capability_secret
+        if hasattr(secret, "current"):
+            # federated issuance (docs/FEDERATION.md): the secret is a
+            # CellKeyring — the cell + key id ride INSIDE the signed
+            # bytes, so a promoted DR cell can keep honoring this grant
+            # while a retired key fails verification loudly
+            kid, secret = secret.current()
+            extra["cell"] = (self.cell_id
+                             or getattr(self.capability_secret,
+                                        "cell_id", None))
+            extra["kid"] = int(kid)
         return EpochCapability(
             fingerprint=self.spec.fingerprint(include_world=False),
             epoch=int(epoch),
@@ -1835,7 +1979,7 @@ class IndexServer(DispatchListener):
             orphans=tuple(dict(o) for o in self._orphans),
             tenant=self.tenant_id,
             **extra,
-        ).signed(self.capability_secret)
+        ).signed(secret)
 
     def _on_get_capability(self, sock, conn_id, header) -> None:
         """Issue a signed epoch capability (docs/CAPABILITY.md): the
@@ -2526,6 +2670,11 @@ class IndexServer(DispatchListener):
                           f"client sent {proto!r}",
             })
             return
+        cell_refusal = self._cell_refusal(header)
+        if cell_refusal is not None:
+            _annotate(error_code="wrong_cell")
+            P.send_msg(sock, P.MSG_ERROR, cell_refusal)
+            return
         engine = self._route_hello(sock, header)
         if engine is None:
             return  # refusal already sent
@@ -2729,6 +2878,10 @@ class IndexServer(DispatchListener):
                 # additive: shard servers ride their rank→shard map here
                 # (docs/SHARDING.md); empty for a standalone daemon
                 **self._welcome_extra(),
+                # additive: the serving cell + global directory on a
+                # federated deployment (docs/FEDERATION.md); empty
+                # otherwise — front-server facts, like term/standby
+                **front._cell_fields(),
                 # additive: the autopilot's batch-size suggestion; the
                 # field does not exist until a controller has tuned it
                 # (docs/AUTOPILOT.md)

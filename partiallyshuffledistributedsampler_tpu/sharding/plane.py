@@ -30,7 +30,8 @@ class ShardPlane:
                  wal_dir: Optional[str] = None,
                  snapshot_dir: Optional[str] = None,
                  multi_tenant: bool = False,
-                 server_kwargs: Optional[dict] = None):
+                 server_kwargs: Optional[dict] = None,
+                 router_kwargs: Optional[dict] = None):
         self.spec = spec
         self.map = ShardMap.for_world(spec.world, n_shards)
         self.host, self.router_port = host, int(router_port)
@@ -39,6 +40,9 @@ class ShardPlane:
         self.snapshot_dir = snapshot_dir
         self.multi_tenant = bool(multi_tenant)
         self.server_kwargs = dict(server_kwargs or {})
+        #: extra ShardRouter kwargs — a federated Cell threads its
+        #: ``cell_id``/``cell_directory`` through here (docs/FEDERATION.md)
+        self.router_kwargs = dict(router_kwargs or {})
         self.shards: list = []
         self.standbys: list = []
         self.router: Optional[ShardRouter] = None
@@ -75,7 +79,8 @@ class ShardPlane:
         self.router = ShardRouter(
             self.spec, self.map, self.host, self.router_port,
             snapshot_path=self._snap("router.json"),
-            multi_tenant=self.multi_tenant)
+            multi_tenant=self.multi_tenant,
+            **self.router_kwargs)
         return self.router.start()
 
     @property

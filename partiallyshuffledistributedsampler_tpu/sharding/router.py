@@ -42,6 +42,7 @@ from .. import faults as F
 from .. import telemetry
 from ..analysis.lockorder import new_lock
 from ..service import protocol as P
+from ..tenancy import tenant_id_for
 from ..service.dispatch import DispatchListener
 from ..service.metrics import ServiceMetrics
 from ..utils.checkpoint import load_sampler_state, save_sampler_state
@@ -63,9 +64,15 @@ class ShardRouter(DispatchListener):
                  rpc_timeout: float = 5.0,
                  multi_tenant: bool = False,
                  metrics: Optional[ServiceMetrics] = None,
-                 clock=time.monotonic):
+                 clock=time.monotonic,
+                 cell_id: Optional[str] = None,
+                 cell_directory=None):
         self.spec = spec
         self.host, self.port = host, int(port)
+        #: federation facts (docs/FEDERATION.md): the cell this router
+        #: fronts and the shared directory holder; both None unfederated
+        self.cell_id = None if cell_id is None else str(cell_id)
+        self._cell_directory = cell_directory
         self.snapshot_path = snapshot_path
         self.rpc_timeout = float(rpc_timeout)
         self.multi_tenant = bool(multi_tenant)
@@ -264,6 +271,56 @@ class ShardRouter(DispatchListener):
                       "the owning shard from the attached shard_map",
         }
 
+    # -------------------------------------------------- multi-cell federation
+    def _cell_dir(self):
+        """The live ``CellDirectory`` (duck-typed holder or value), or
+        None unfederated — the server-side helper's twin."""
+        d = self._cell_directory
+        if d is None:
+            return None
+        return d.current() if hasattr(d, "current") else d
+
+    def _cell_fields(self) -> dict:
+        if self.cell_id is None:
+            return {}
+        out = {"cell": self.cell_id}
+        d = self._cell_dir()
+        if d is not None:
+            out["cell_directory"] = d.to_wire()
+        return out
+
+    def _cell_refusal(self, header: dict) -> Optional[dict]:
+        """The router's cell gate: same typed retryable ``wrong_cell``
+        redirect its shards answer with (docs/FEDERATION.md), so a
+        client dialing the wrong cell's ROUTER is re-pointed before it
+        ever reaches a shard.  Failover HELLOs are exempt, exactly as
+        at the shard gate: the dying home cell's clients must be able
+        to reach the DR cell before the directory flips."""
+        if self.cell_id is None or header.get("failover"):
+            return None
+        d = self._cell_dir()
+        if d is None:
+            return None
+        tenant = header.get("tenant")
+        if tenant is None:
+            fp = header.get("spec_fingerprint")
+            tenant = (tenant_id_for(str(fp)) if fp is not None
+                      else tenant_id_for(
+                          self.spec.fingerprint(include_world=False)))
+        home = d.home(str(tenant))
+        if home == self.cell_id:
+            return None
+        self.metrics.inc("cell_redirects")
+        return {
+            "code": "wrong_cell", "retry_ms": 25,
+            "cell": self.cell_id,
+            "home": home,
+            "cell_directory": d.to_wire(),
+            "detail": f"tenant {tenant} is homed at cell {home!r}; this "
+                      f"router fronts cell {self.cell_id!r} (directory "
+                      f"v{d.version})",
+        }
+
     # ----------------------------------------------------------------- HELLO
     def _on_hello(self, sock, header) -> None:
         t0 = time.perf_counter()
@@ -288,6 +345,10 @@ class ShardRouter(DispatchListener):
                           "plane is single-tenant",
             })
             return
+        cell_refusal = self._cell_refusal(header)
+        if cell_refusal is not None:
+            P.send_msg(sock, P.MSG_ERROR, cell_refusal)
+            return
         try:
             F.fire("router.route")
         except F.InjectedThreadDeath:
@@ -308,6 +369,9 @@ class ShardRouter(DispatchListener):
             "router": True,
             "rank": header.get("rank"),
             "shard_map": m.to_wire(),
+            # additive: serving cell + global directory on a federated
+            # deployment (docs/FEDERATION.md); empty otherwise
+            **self._cell_fields(),
         }
         self.metrics.registry.histogram("router_route_ms").observe(
             (time.perf_counter() - t0) * 1e3)

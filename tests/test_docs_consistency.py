@@ -28,6 +28,7 @@ _NAMESPACES = (
     "partiallyshuffledistributedsampler_tpu.ops.cpu",
     "partiallyshuffledistributedsampler_tpu.service",
     "partiallyshuffledistributedsampler_tpu.sharding",
+    "partiallyshuffledistributedsampler_tpu.federation",
     "partiallyshuffledistributedsampler_tpu.autopilot",
     "partiallyshuffledistributedsampler_tpu.fleetsim",
     "partiallyshuffledistributedsampler_tpu.capability",
@@ -516,4 +517,54 @@ def test_sampling_doc_cross_linked():
 
     res = (DOCS / "RESILIENCE.md").read_text()
     for site in ("sampling.alias_build", "sampling.dedup_check"):
+        assert site in F.SITES and site in res
+
+
+def test_federation_doc_cross_linked():
+    """The multi-cell plane is documented where an operator would
+    look: docs/FEDERATION.md owns the directory/shipping/fencing/
+    migration story (and the make gate), SERVICE.md / SHARDING.md /
+    CAPABILITY.md / RESILIENCE.md / OBSERVABILITY.md / API.md and
+    README.md link to it, API.md documents the public surface,
+    OBSERVABILITY.md the metric names, and the documented fault sites
+    are the registered ones."""
+    federation_md = DOCS / "FEDERATION.md"
+    assert federation_md.exists()
+    text = federation_md.read_text()
+    for token in ("Cell", "Federation", "CellDirectory", "DirectoryRef",
+                  "wrong_cell", "WalShipper", "CellKeyring", "TrustBundle",
+                  "fenced", "flip_cell", "migrate_tenant",
+                  "MigrationAborted", "failover_ms", "kill-at-any-byte",
+                  "federation-smoke"):
+        assert token in text, f"docs/FEDERATION.md lost `{token}`"
+    for doc in ("SERVICE.md", "SHARDING.md", "CAPABILITY.md",
+                "RESILIENCE.md", "OBSERVABILITY.md", "API.md"):
+        assert "FEDERATION.md" in (DOCS / doc).read_text(), (
+            f"docs/{doc} lost its cross-link to docs/FEDERATION.md")
+    assert "docs/FEDERATION.md" in (DOCS.parent / "README.md").read_text()
+    svc = (DOCS / "SERVICE.md").read_text()
+    assert "## Multi-cell federation" in svc, (
+        "docs/SERVICE.md lost its Multi-cell federation section")
+    assert "wrong_cell" in svc, (
+        "docs/SERVICE.md lost the `wrong_cell` redirect")
+    api = API_MD.read_text()
+    for token in ("CellDirectory(cells", "DirectoryRef(directory=None",
+                  "CellKeyring", "TrustBundle(keyrings=()", "WalShipper",
+                  "Cell(cell_id", "Federation(spec, *, root",
+                  "migrate_tenant", "MigrationAborted"):
+        assert token in api, (
+            f"docs/API.md lost the federation surface `{token}`")
+    obs = OBSERVABILITY_MD.read_text()
+    for token in ("cell_shipped", "cell_ship_resyncs", "cell_ship_lag_ms",
+                  "cell_redirects", "wrong_cell_redirects", "cell_fenced",
+                  "cell_fence_faults", "federation_failovers",
+                  "federation_migrations", "federation_migrate_aborts",
+                  "sim_cell_kills"):
+        assert token in obs, (
+            f"docs/OBSERVABILITY.md lost the federation metric `{token}`")
+    # the documented fault sites must be the registered ones
+    from partiallyshuffledistributedsampler_tpu import faults as F
+
+    res = (DOCS / "RESILIENCE.md").read_text()
+    for site in ("cell.ship", "cell.fence", "cell.migrate"):
         assert site in F.SITES and site in res

@@ -170,7 +170,12 @@ def test_kill_primary_at_drain_boundary_union_law():
     delivered = {}
     lock = threading.Lock()
     b_hit = threading.Barrier(2)
-    b_go = threading.Barrier(2)
+    # THREE parties: both workers AND the main thread — the workers must
+    # not resume their streams until the primary is already dead, else a
+    # fast drain can complete the whole epoch before the kill lands and
+    # the standby is never asked to promote (the race this test means to
+    # pin, not dodge)
+    b_go = threading.Barrier(3)
 
     def worker(r):
         got = []
@@ -205,6 +210,7 @@ def test_kill_primary_at_drain_boundary_union_law():
                  or primary._state_dict()["generation"] >= 1, timeout=30.0)
         wait_synced(primary, standby)
         primary.kill()
+        b_go.wait(timeout=30.0)  # release the workers onto the dead primary
         for t in threads:
             t.join(timeout=60.0)
             assert not t.is_alive(), "drain-boundary worker hung"
